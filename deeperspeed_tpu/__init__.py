@@ -1,0 +1,53 @@
+"""DeeperSpeed-TPU: a TPU-native large-model training framework.
+
+Re-creates the capabilities of zhuzilin/DeeperSpeed (DeepSpeed v0.3.15) on
+JAX/XLA/Pallas: engine + config, ZeRO 1/2/3 via sharding, pipeline/tensor/
+sequence parallelism over an ICI mesh, bf16/fp16 mixed precision, compressed
+communication, fused kernels, checkpointing, elasticity, profiling, and a
+multi-host launcher. API names mirror the reference
+(/root/reference/deepspeed/__init__.py) so callers can port directly.
+"""
+
+from .version import __version__, __version_info__
+
+from .runtime.config import TrainingConfig, DeepSpeedConfig, ConfigError
+from .runtime.engine import Engine, initialize
+from .runtime import lr_schedules
+from .parallel.topology import (
+    ProcessTopology,
+    PipeDataParallelTopology,
+    PipeModelDataParallelTopology,
+    PipelineParallelGrid,
+    build_mesh,
+)
+from .utils import logger, log_dist
+
+
+def add_config_arguments(parser):
+    """Argparse flags matching reference deepspeed/__init__.py:199."""
+    group = parser.add_argument_group("DeepSpeed", "DeepSpeed configurations")
+    group.add_argument(
+        "--deepspeed",
+        default=False,
+        action="store_true",
+        help="Enable DeepSpeed (helper flag for user code, no impact on library)",
+    )
+    group.add_argument(
+        "--deepspeed_config", default=None, type=str, help="DeepSpeed json config file."
+    )
+    group.add_argument(
+        "--deepscale",
+        default=False,
+        action="store_true",
+        help="Deprecated enable DeepSpeed (helper flag for user code)",
+    )
+    group.add_argument(
+        "--deepscale_config", default=None, type=str, help="Deprecated json config file."
+    )
+    group.add_argument(
+        "--deepspeed_mpi",
+        default=False,
+        action="store_true",
+        help="Run via MPI; discover ranks from the MPI environment.",
+    )
+    return parser

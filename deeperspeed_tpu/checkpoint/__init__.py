@@ -1,0 +1,8 @@
+from .serialization import (
+    CheckpointEngine,
+    consolidate_fp32_state,
+    load_tree,
+    save_tree,
+    read_latest,
+    write_latest,
+)

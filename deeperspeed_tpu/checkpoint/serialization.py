@@ -1,0 +1,137 @@
+"""Checkpoint serialization + directory layout.
+
+Capability parity with the reference checkpoint machinery
+(/root/reference/deepspeed/runtime/engine.py:1462-1817): tag directories, a
+`latest` pointer file, model-state vs optimizer-state files named by mp/pp
+rank, tag-consistency validation, and the `zero_to_fp32` consolidation path.
+Tensors serialize via flax msgpack (host numpy); sharded arrays are gathered
+by the caller before save in single-process mode, or saved per-process via
+the sharded save path.
+"""
+
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+from flax import serialization
+
+LATEST_FILE = "latest"
+
+
+def model_state_filename(mp_rank: int = 0) -> str:
+    return f"mp_rank_{mp_rank:02d}_model_states.msgpack"
+
+
+def optim_state_filename(dp_rank: int = 0, mp_rank: int = 0) -> str:
+    return f"zero_pp_rank_{dp_rank}_mp_rank_{mp_rank:02d}_optim_states.msgpack"
+
+
+def layer_ckpt_filename(layer_idx: int, mp_rank: int = 0) -> str:
+    # parity with pipe/module.py ckpt_layer_path naming
+    return f"layer_{layer_idx:02d}-model_{mp_rank:02d}-model_states.msgpack"
+
+
+def to_host(tree):
+    """device arrays -> numpy (gathers sharded arrays in-process); plain
+    python scalars/strings pass through untouched."""
+
+    def leaf(x):
+        if isinstance(x, (str, bytes, bool, int, float, type(None))):
+            return x
+        return np.asarray(jax.device_get(x))
+
+    return jax.tree.map(leaf, tree)
+
+
+def save_tree(path: str, tree: Any):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    data = serialization.to_bytes(to_host(tree))
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+    os.replace(tmp, path)
+
+
+def load_tree(path: str, target: Optional[Any] = None):
+    with open(path, "rb") as f:
+        data = f.read()
+    if target is not None:
+        return serialization.from_bytes(target, data)
+    return serialization.msgpack_restore(data)
+
+
+def write_latest(save_dir: str, tag: str):
+    os.makedirs(save_dir, exist_ok=True)
+    tmp = os.path.join(save_dir, LATEST_FILE + ".tmp")
+    with open(tmp, "w") as f:
+        f.write(tag)
+    os.replace(tmp, os.path.join(save_dir, LATEST_FILE))
+
+
+def read_latest(load_dir: str) -> Optional[str]:
+    p = os.path.join(load_dir, LATEST_FILE)
+    if not os.path.isfile(p):
+        return None
+    with open(p) as f:
+        return f.read().strip()
+
+
+def validate_tag_across_processes(tag: str, fail_on_mismatch: bool) -> bool:
+    """Cross-process checkpoint-tag consistency (parity with the sha1
+    allreduce at reference engine.py:1671). Single-process: trivially true;
+    multi-process: compare hashes via a tiny psum."""
+    import hashlib
+
+    if jax.process_count() == 1:
+        return True
+    digest = int.from_bytes(
+        hashlib.sha1(tag.encode()).digest()[:4], "little", signed=False
+    )
+    arr = np.array([digest], dtype=np.int64)
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(arr)
+    ok = bool(np.all(gathered == digest))
+    if not ok:
+        if fail_on_mismatch:
+            raise ValueError(f"checkpoint tag '{tag}' differs across processes")
+        from ..utils.logging import logger
+
+        logger.warning("checkpoint tag '%s' differs across processes", tag)
+    return ok
+
+
+class CheckpointEngine:
+    """File layout + IO for one checkpoint directory."""
+
+    def __init__(self, save_dir: str, tag: str):
+        self.ckpt_dir = os.path.join(save_dir, str(tag))
+
+    def path(self, filename: str) -> str:
+        return os.path.join(self.ckpt_dir, filename)
+
+    def save(self, filename: str, tree: Any):
+        save_tree(self.path(filename), tree)
+
+    def load(self, filename: str, target: Optional[Any] = None):
+        return load_tree(self.path(filename), target)
+
+    def exists(self, filename: str) -> bool:
+        return os.path.isfile(self.path(filename))
+
+
+def consolidate_fp32_state(checkpoint_dir: str) -> Dict:
+    """zero_to_fp32 equivalent (reference utils/zero_to_fp32.py:70): returns
+    the consolidated fp32 master weights from a checkpoint dir."""
+    for fname in sorted(os.listdir(checkpoint_dir)):
+        if fname.startswith("zero_pp_rank_") and fname.endswith(".msgpack"):
+            optim = load_tree(os.path.join(checkpoint_dir, fname))
+            if isinstance(optim, dict) and "master" in optim and optim["master"]:
+                return optim["master"]
+    # fall back to model states (fp32 training keeps no separate master)
+    for fname in sorted(os.listdir(checkpoint_dir)):
+        if fname.endswith("model_states.msgpack"):
+            state = load_tree(os.path.join(checkpoint_dir, fname))
+            return state.get("module", state)
+    raise FileNotFoundError(f"no checkpoint states found in {checkpoint_dir}")

@@ -1,0 +1,9 @@
+from .elasticity import (
+    ElasticityConfig,
+    ElasticityError,
+    ElasticityConfigError,
+    ElasticityIncompatibleWorldSize,
+    compute_elastic_config,
+    ensure_immutable_elastic_config,
+)
+from . import constants

@@ -1,0 +1,3 @@
+from .adam import FusedAdam, DeepSpeedCPUAdam, AdamState
+from .lamb import FusedLamb, LambState
+from .sgd import SGD, SGDState

@@ -1,0 +1,48 @@
+"""SGD with momentum (torch.optim.SGD-compatible semantics)."""
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class SGDState(NamedTuple):
+    step: jnp.ndarray
+    momentum_buf: object
+
+
+class SGD:
+    def __init__(self, lr=1e-3, momentum=0.0, weight_decay=0.0, nesterov=False):
+        self.lr = lr
+        self.momentum = momentum
+        self.weight_decay = weight_decay
+        self.nesterov = nesterov
+
+    def init(self, params) -> SGDState:
+        zeros = lambda p: jnp.zeros(p.shape, jnp.float32)
+        return SGDState(
+            step=jnp.zeros((), jnp.int32), momentum_buf=jax.tree.map(zeros, params)
+        )
+
+    def update(self, grads, state: SGDState, params, lr: Optional[jnp.ndarray] = None):
+        lr = self.lr if lr is None else lr
+
+        def leaf(p, g, b):
+            g = g.astype(jnp.float32)
+            p = p.astype(jnp.float32)
+            if self.weight_decay:
+                g = g + self.weight_decay * p
+            b_ = self.momentum * b + g
+            d = g + self.momentum * b_ if self.nesterov else (b_ if self.momentum else g)
+            return p - lr * d, b_
+
+        flat_p, treedef = jax.tree.flatten(params)
+        flat_g = treedef.flatten_up_to(grads)
+        flat_b = treedef.flatten_up_to(state.momentum_buf)
+        out = [leaf(p, g, b) for p, g, b in zip(flat_p, flat_g, flat_b)]
+        return (
+            treedef.unflatten([o[0] for o in out]),
+            SGDState(
+                step=state.step + 1, momentum_buf=treedef.unflatten([o[1] for o in out])
+            ),
+        )

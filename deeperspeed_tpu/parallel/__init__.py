@@ -1,0 +1,13 @@
+from .topology import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    PIPE_AXIS,
+    SEQ_AXIS,
+    EXPERT_AXIS,
+    ProcessTopology,
+    PipeDataParallelTopology,
+    PipeModelDataParallelTopology,
+    PipelineParallelGrid,
+    build_mesh,
+    single_device_mesh,
+)

@@ -1,0 +1,56 @@
+"""Activation checkpointing config block (schema parity with
+/root/reference/deepspeed/runtime/activation_checkpointing/config.py).
+
+On TPU these map onto `jax.checkpoint` (remat) policies:
+  partition_activations  -> sequence/model-sharded saved residuals
+  cpu_checkpointing      -> `jax.checkpoint` with offload-to-host policy
+  contiguous_memory_optimization / synchronize / profile retained for schema
+  compatibility (no-ops or debug toggles under XLA).
+"""
+
+from ..config_utils import ConfigObject, get_scalar_param
+
+ACTIVATION_CHKPT = "activation_checkpointing"
+
+ACT_CHKPT_PARTITION_ACTIVATIONS = "partition_activations"
+ACT_CHKPT_PARTITION_ACTIVATIONS_DEFAULT = False
+
+ACT_CHKPT_NUMBER_CHECKPOINTS = "number_checkpoints"
+ACT_CHKPT_NUMBER_CHECKPOINTS_DEFAULT = None
+
+ACT_CHKPT_CONTIGUOUS_MEMORY_OPTIMIZATION = "contiguous_memory_optimization"
+ACT_CHKPT_CONTIGUOUS_MEMORY_OPTIMIZATION_DEFAULT = False
+
+ACT_CHKPT_SYNCHRONIZE_CHECKPOINT_BOUNDARY = "synchronize_checkpoint_boundary"
+ACT_CHKPT_SYNCHRONIZE_CHECKPOINT_BOUNDARY_DEFAULT = False
+
+ACT_CHKPT_PROFILE = "profile"
+ACT_CHKPT_PROFILE_DEFAULT = False
+
+ACT_CHKPT_CPU_CHECKPOINTING = "cpu_checkpointing"
+ACT_CHKPT_CPU_CHECKPOINTING_DEFAULT = False
+
+
+class ActivationCheckpointingConfig(ConfigObject):
+    def __init__(self, param_dict=None):
+        d = (param_dict or {}).get(ACTIVATION_CHKPT, {})
+        self.partition_activations = get_scalar_param(
+            d, ACT_CHKPT_PARTITION_ACTIVATIONS, ACT_CHKPT_PARTITION_ACTIVATIONS_DEFAULT
+        )
+        self.number_checkpoints = get_scalar_param(
+            d, ACT_CHKPT_NUMBER_CHECKPOINTS, ACT_CHKPT_NUMBER_CHECKPOINTS_DEFAULT
+        )
+        self.contiguous_memory_optimization = get_scalar_param(
+            d,
+            ACT_CHKPT_CONTIGUOUS_MEMORY_OPTIMIZATION,
+            ACT_CHKPT_CONTIGUOUS_MEMORY_OPTIMIZATION_DEFAULT,
+        )
+        self.synchronize_checkpoint_boundary = get_scalar_param(
+            d,
+            ACT_CHKPT_SYNCHRONIZE_CHECKPOINT_BOUNDARY,
+            ACT_CHKPT_SYNCHRONIZE_CHECKPOINT_BOUNDARY_DEFAULT,
+        )
+        self.profile = get_scalar_param(d, ACT_CHKPT_PROFILE, ACT_CHKPT_PROFILE_DEFAULT)
+        self.cpu_checkpointing = get_scalar_param(
+            d, ACT_CHKPT_CPU_CHECKPOINTING, ACT_CHKPT_CPU_CHECKPOINTING_DEFAULT
+        )

@@ -1,0 +1,331 @@
+"""Master training config.
+
+Capability parity with /root/reference/deepspeed/runtime/config.py:536
+(`DeepSpeedConfig`): JSON (file/dict) parsing, batch-triple derivation
+(`_set_batch_related_parameters` :701, `_batch_assertion` :681), precision
+selection including the fork's `fp16.type: bfloat16` (:97), sub-config blocks
+(zero / activation checkpointing / aio / flops / pipeline / PLD / sparse
+attention / tensorboard / checkpoint-tag validation), and the elasticity
+batch rewrite (:558-609). Re-implemented; no torch.
+"""
+
+from ..elasticity import (
+    compute_elastic_config,
+    ensure_immutable_elastic_config,
+)
+from ..elasticity import constants as ec
+from ..profiling.config import FlopsProfilerConfig
+from ..utils.logging import logger
+from . import constants as c
+from .activation_checkpointing.config import ActivationCheckpointingConfig
+from .config_utils import get_scalar_param, load_config
+from .offload.aio_config import AioConfig
+from .zero.config import ZeroConfig
+
+
+class ConfigError(Exception):
+    pass
+
+
+class TrainingConfig:
+    """The TPU-native DeepSpeedConfig."""
+
+    def __init__(self, config, world_size=1):
+        import copy
+
+        # deep-copy so elasticity's batch rewrite never mutates caller data
+        self._param_dict = copy.deepcopy(load_config(config))
+        self.world_size = world_size
+        self.elasticity_enabled = False
+        self.elastic_valid_world_sizes = None
+
+        self._handle_elasticity()
+        self._initialize_params(self._param_dict)
+        self._set_batch_related_parameters()
+        self._do_sanity_check()
+
+    # ------------------------------------------------------------------ #
+
+    def _handle_elasticity(self):
+        pd = self._param_dict
+        elastic_dict = pd.get(ec.ELASTICITY, {})
+        if not elastic_dict.get(ec.ENABLED, ec.ENABLED_DEFAULT):
+            return
+        self.elasticity_enabled = True
+        ensure_immutable_elastic_config(elastic_dict)
+        final_batch_size, valid_gpus, micro_batch = compute_elastic_config(
+            pd, world_size=self.world_size
+        )
+        self.elastic_valid_world_sizes = valid_gpus
+
+        ignore = elastic_dict.get(
+            ec.IGNORE_NON_ELASTIC_BATCH_INFO, ec.IGNORE_NON_ELASTIC_BATCH_INFO_DEFAULT
+        )
+        batch_keys = (
+            c.TRAIN_BATCH_SIZE,
+            c.TRAIN_MICRO_BATCH_SIZE_PER_GPU,
+            c.GRADIENT_ACCUMULATION_STEPS,
+        )
+        if not ignore and any(k in pd for k in batch_keys):
+            raise ConfigError(
+                "elasticity is enabled — batch parameters "
+                f"{batch_keys} must not be set (or set "
+                f"elasticity.{ec.IGNORE_NON_ELASTIC_BATCH_INFO}: true)"
+            )
+        gas = final_batch_size // (micro_batch * self.world_size)
+        pd[c.TRAIN_BATCH_SIZE] = final_batch_size
+        pd[c.TRAIN_MICRO_BATCH_SIZE_PER_GPU] = micro_batch
+        pd[c.GRADIENT_ACCUMULATION_STEPS] = gas
+        logger.info(
+            "elasticity rewrote batch params: train=%d micro=%d gas=%d",
+            final_batch_size,
+            micro_batch,
+            gas,
+        )
+
+    def _initialize_params(self, pd):
+        self.train_batch_size = get_scalar_param(
+            pd, c.TRAIN_BATCH_SIZE, c.TRAIN_BATCH_SIZE_DEFAULT
+        )
+        self.train_micro_batch_size_per_gpu = get_scalar_param(
+            pd, c.TRAIN_MICRO_BATCH_SIZE_PER_GPU, c.TRAIN_MICRO_BATCH_SIZE_PER_GPU_DEFAULT
+        )
+        self.gradient_accumulation_steps = get_scalar_param(
+            pd, c.GRADIENT_ACCUMULATION_STEPS, c.GRADIENT_ACCUMULATION_STEPS_DEFAULT
+        )
+        self.steps_per_print = get_scalar_param(
+            pd, c.STEPS_PER_PRINT, c.STEPS_PER_PRINT_DEFAULT
+        )
+        self.dump_state = get_scalar_param(pd, c.DUMP_STATE, c.DUMP_STATE_DEFAULT)
+
+        self.gradient_clipping = get_scalar_param(
+            pd, c.GRADIENT_CLIPPING, c.GRADIENT_CLIPPING_DEFAULT
+        )
+        self.prescale_gradients = get_scalar_param(
+            pd, c.PRESCALE_GRADIENTS, c.PRESCALE_GRADIENTS_DEFAULT
+        )
+        self.gradient_predivide_factor = get_scalar_param(
+            pd, c.GRADIENT_PREDIVIDE_FACTOR, c.GRADIENT_PREDIVIDE_FACTOR_DEFAULT
+        )
+        self.sparse_gradients_enabled = get_scalar_param(
+            pd, c.SPARSE_GRADIENTS, c.SPARSE_GRADIENTS_DEFAULT
+        )
+        self.fp32_allreduce = get_scalar_param(
+            pd, c.FP32_ALLREDUCE, c.FP32_ALLREDUCE_DEFAULT
+        )
+        self.allgather_size = get_scalar_param(
+            pd, c.ALLGATHER_SIZE, c.ALLGATHER_SIZE_DEFAULT
+        )
+
+        # ---- precision ----
+        fp16_dict = pd.get(c.FP16, {})
+        bf16_dict = pd.get(c.BFLOAT16, {})
+        self.fp16_enabled = fp16_dict.get(c.FP16_ENABLED, c.FP16_ENABLED_DEFAULT)
+        fp16_type = fp16_dict.get(c.FP16_TYPE, c.FP16_TYPE_DEFAULT)
+        bf16_enabled = bf16_dict.get(c.BFLOAT16_ENABLED, c.BFLOAT16_ENABLED_DEFAULT)
+        if self.fp16_enabled and fp16_type in ("bfloat16", "bf16"):
+            self.precision = c.PRECISION_BF16
+        elif self.fp16_enabled:
+            self.precision = c.PRECISION_FP16
+        elif bf16_enabled:
+            self.precision = c.PRECISION_BF16
+        else:
+            self.precision = c.PRECISION_FP32
+        self.bfloat16_enabled = self.precision == c.PRECISION_BF16
+
+        self.loss_scale = fp16_dict.get(c.FP16_LOSS_SCALE, c.FP16_LOSS_SCALE_DEFAULT)
+        self.initial_scale_power = fp16_dict.get(
+            c.FP16_INITIAL_SCALE_POWER, c.FP16_INITIAL_SCALE_POWER_DEFAULT
+        )
+        self.loss_scale_window = fp16_dict.get(
+            c.FP16_LOSS_SCALE_WINDOW, c.FP16_LOSS_SCALE_WINDOW_DEFAULT
+        )
+        self.hysteresis = fp16_dict.get(c.FP16_HYSTERESIS, c.FP16_HYSTERESIS_DEFAULT)
+        self.min_loss_scale = fp16_dict.get(
+            c.FP16_MIN_LOSS_SCALE, c.FP16_MIN_LOSS_SCALE_DEFAULT
+        )
+        # bf16 trains without loss scaling (static scale 1.0), like the fork
+        if self.precision == c.PRECISION_BF16 and c.FP16_LOSS_SCALE not in fp16_dict:
+            self.loss_scale = 1.0
+
+        # ---- optimizer / scheduler ----
+        optimizer_dict = pd.get(c.OPTIMIZER, None)
+        self.optimizer_name = None
+        self.optimizer_params = None
+        self.optimizer_legacy_fusion = c.LEGACY_FUSION_DEFAULT
+        if optimizer_dict is not None:
+            self.optimizer_name = optimizer_dict.get(c.TYPE, None)
+            self.optimizer_params = optimizer_dict.get(c.OPTIMIZER_PARAMS, {})
+            self.optimizer_legacy_fusion = optimizer_dict.get(
+                c.LEGACY_FUSION, c.LEGACY_FUSION_DEFAULT
+            )
+        scheduler_dict = pd.get(c.SCHEDULER, None)
+        self.scheduler_name = None
+        self.scheduler_params = None
+        if scheduler_dict is not None:
+            self.scheduler_name = scheduler_dict.get(c.TYPE, None)
+            self.scheduler_params = scheduler_dict.get(c.SCHEDULER_PARAMS, {})
+
+        self.zero_allow_untested_optimizer = get_scalar_param(
+            pd, c.ZERO_ALLOW_UNTESTED_OPTIMIZER, c.ZERO_ALLOW_UNTESTED_OPTIMIZER_DEFAULT
+        )
+
+        # ---- sub-configs ----
+        self.zero_config = ZeroConfig(pd)
+        self.zero_enabled = self.zero_config.enabled
+        self.zero_optimization_stage = self.zero_config.stage
+        self.activation_checkpointing_config = ActivationCheckpointingConfig(pd)
+        self.aio_config = AioConfig(pd)
+        self.flops_profiler_config = FlopsProfilerConfig(pd)
+
+        self.wall_clock_breakdown = get_scalar_param(
+            pd, c.WALL_CLOCK_BREAKDOWN, c.WALL_CLOCK_BREAKDOWN_DEFAULT
+        )
+        self.memory_breakdown = get_scalar_param(
+            pd, c.MEMORY_BREAKDOWN, c.MEMORY_BREAKDOWN_DEFAULT
+        )
+
+        tb = pd.get(c.TENSORBOARD, {})
+        self.tensorboard_enabled = tb.get(
+            c.TENSORBOARD_ENABLED, c.TENSORBOARD_ENABLED_DEFAULT
+        )
+        self.tensorboard_output_path = tb.get(
+            c.TENSORBOARD_OUTPUT_PATH, c.TENSORBOARD_OUTPUT_PATH_DEFAULT
+        )
+        self.tensorboard_job_name = tb.get(
+            c.TENSORBOARD_JOB_NAME, c.TENSORBOARD_JOB_NAME_DEFAULT
+        )
+
+        pld = pd.get(c.PROGRESSIVE_LAYER_DROP, {})
+        self.pld_enabled = pld.get(c.PLD_ENABLED, c.PLD_ENABLED_DEFAULT)
+        self.pld_params = (
+            {
+                c.PLD_THETA: pld.get(c.PLD_THETA, c.PLD_THETA_DEFAULT),
+                c.PLD_GAMMA: pld.get(c.PLD_GAMMA, c.PLD_GAMMA_DEFAULT),
+            }
+            if self.pld_enabled
+            else False
+        )
+
+        ckpt = pd.get(c.CHECKPOINT, {})
+        validation_mode = ckpt.get(
+            c.CHECKPOINT_TAG_VALIDATION, c.CHECKPOINT_TAG_VALIDATION_DEFAULT
+        )
+        self.checkpoint_tag_validation_mode = str(validation_mode).capitalize()
+        if self.checkpoint_tag_validation_mode not in c.CHECKPOINT_TAG_VALIDATION_MODES:
+            raise ConfigError(
+                f"{c.CHECKPOINT_TAG_VALIDATION}: {validation_mode} invalid, "
+                f"must be one of {c.CHECKPOINT_TAG_VALIDATION_MODES}"
+            )
+        self.checkpoint_tag_validation_enabled = (
+            self.checkpoint_tag_validation_mode != "Ignore"
+        )
+        self.checkpoint_tag_validation_fail = self.checkpoint_tag_validation_mode == "Fail"
+        self.load_from_fp32_weights = get_scalar_param(
+            pd, c.LOAD_FROM_FP32_WEIGHTS, True
+        )
+
+        self.pipeline = pd.get(c.PIPELINE, {})
+        self.sparse_attention = pd.get(c.SPARSE_ATTENTION, None)
+
+        bs_sched = pd.get(c.BATCH_SCHEDULER, {})
+        if isinstance(bs_sched, dict):
+            self.batch_scheduler_enabled = bs_sched.get(
+                c.BATCH_SCHEDULER_ENABLED, c.BATCH_SCHEDULER_ENABLED_DEFAULT
+            )
+            self.batch_scheduler_params = bs_sched
+        else:
+            self.batch_scheduler_enabled = bool(bs_sched)
+            self.batch_scheduler_params = {}
+
+        self.gradient_noise_scale = pd.get(c.GRADIENT_NOISE_SCALE, None)
+
+    # ------------------------------------------------------------------ #
+
+    def _batch_assertion(self):
+        train = self.train_batch_size
+        micro = self.train_micro_batch_size_per_gpu
+        gas = self.gradient_accumulation_steps
+        assert train > 0, f"Train batch size: {train} has to be greater than 0"
+        assert micro > 0, f"Micro batch size per gpu: {micro} has to be greater than 0"
+        assert gas > 0, f"Gradient accumulation steps: {gas} has to be greater than 0"
+        assert train == micro * gas * self.world_size, (
+            f"Check batch related parameters. train_batch_size is not equal "
+            f"to micro_batch_per_gpu * gradient_acc_step * world_size "
+            f"{train} != {micro} * {gas} * {self.world_size}"
+        )
+
+    def _set_batch_related_parameters(self):
+        train = self.train_batch_size
+        micro = self.train_micro_batch_size_per_gpu
+        gas = self.gradient_accumulation_steps
+
+        # all three parameters provided — just validate below
+        if all(x is not None for x in (train, micro, gas)):
+            pass
+        # global + micro -> derive gas
+        elif train is not None and micro is not None:
+            gas = train // (micro * self.world_size)
+            self.gradient_accumulation_steps = gas
+        # global + gas -> derive micro
+        elif train is not None and gas is not None:
+            micro = train // (self.world_size * gas)
+            self.train_micro_batch_size_per_gpu = micro
+        # only global -> gas 1, derive micro
+        elif train is not None:
+            self.gradient_accumulation_steps = 1
+            self.train_micro_batch_size_per_gpu = train // self.world_size
+        # micro (+ maybe gas) -> derive global
+        elif micro is not None:
+            if gas is None:
+                gas = 1
+                self.gradient_accumulation_steps = 1
+            self.train_batch_size = micro * gas * self.world_size
+        else:
+            raise ConfigError(
+                "Either train_batch_size or train_micro_batch_size_per_gpu "
+                "needs to be provided"
+            )
+        self._batch_assertion()
+
+    def _do_sanity_check(self):
+        if self.zero_enabled and self.zero_optimization_stage > 0:
+            if self.precision == c.PRECISION_FP32 and self.zero_optimization_stage >= 2:
+                # fp32 + stage>=2 allowed on TPU (no loss scaling needed); the
+                # reference required fp16 — we only log.
+                logger.info("ZeRO stage %d with fp32", self.zero_optimization_stage)
+        if self.fp16_enabled and self.precision == c.PRECISION_FP16:
+            if self.loss_scale < 0:
+                raise ConfigError("loss_scale must be >= 0 (0 means dynamic)")
+
+    # ------------------------------------------------------------------ #
+
+    @property
+    def dynamic_loss_scale(self):
+        return self.loss_scale == 0
+
+    @property
+    def initial_dynamic_scale(self):
+        return 2**self.initial_scale_power
+
+    @property
+    def dynamic_loss_scale_args(self):
+        if not self.dynamic_loss_scale:
+            return None
+        return {
+            "init_scale": 2**self.initial_scale_power,
+            "scale_window": self.loss_scale_window,
+            "delayed_shift": self.hysteresis,
+            "min_scale": self.min_loss_scale,
+        }
+
+    def print(self, name="TrainingConfig"):
+        logger.info("%s:", name)
+        for key in sorted(self.__dict__):
+            if key == "_param_dict":
+                continue
+            logger.info("  %s = %s", key, self.__dict__[key])
+
+
+# Back-compat alias matching the reference class name
+DeepSpeedConfig = TrainingConfig

@@ -1,0 +1,93 @@
+"""Host input pipeline.
+
+Capability parity with /root/reference/deepspeed/runtime/dataloader.py
+(`DeepSpeedDataLoader` :33, `RepeatingLoader` :10). Instead of a torch
+DistributedSampler handing each rank its slice, the loader yields *global*
+numpy batches; the engine places them on the mesh with a `P('data')` batch
+sharding (each data-parallel slice of the mesh receives its shard — the
+sampler falls out of the sharding). Under multi-host, per-process slicing
+happens at placement time via `jax.make_array_from_process_local_data`.
+"""
+
+import math
+
+import numpy as np
+
+
+class RepeatingLoader:
+    def __init__(self, loader):
+        """Wrap an iterator to restart from the beginning when it ends."""
+        self.loader = loader
+        self.data_iter = iter(self.loader)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            batch = next(self.data_iter)
+        except StopIteration:
+            self.data_iter = iter(self.loader)
+            batch = next(self.data_iter)
+        return batch
+
+
+class DeepSpeedDataLoader:
+    """Batches an indexable dataset of numpy-convertible samples.
+
+    dataset: sequence of samples; each sample is an array, tuple of arrays, or
+    dict of arrays. batch_size here is the GLOBAL effective micro batch
+    (micro_batch_per_gpu * data_parallel_size), matching what one
+    forward/backward consumes across the mesh.
+    """
+
+    def __init__(
+        self,
+        dataset,
+        batch_size,
+        shuffle=False,
+        seed=0,
+        drop_last=True,
+        collate_fn=None,
+        num_local_io_workers=None,  # accepted for API compat; IO is in-process
+        data_parallel_world_size=None,
+        data_parallel_rank=None,
+    ):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.collate_fn = collate_fn or _default_collate
+        self.epoch = 0
+        n = len(dataset)
+        self.len = n // batch_size if drop_last else math.ceil(n / batch_size)
+
+    def __len__(self):
+        return self.len
+
+    def set_epoch(self, epoch):
+        self.epoch = epoch
+
+    def __iter__(self):
+        n = len(self.dataset)
+        order = np.arange(n)
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            rng.shuffle(order)
+        usable = self.len * self.batch_size if self.drop_last else n
+        for start in range(0, usable, self.batch_size):
+            idx = order[start : start + self.batch_size]
+            samples = [self.dataset[int(i)] for i in idx]
+            yield self.collate_fn(samples)
+
+
+def _default_collate(samples):
+    first = samples[0]
+    if isinstance(first, dict):
+        return {k: np.stack([np.asarray(s[k]) for s in samples]) for k in first}
+    if isinstance(first, (tuple, list)):
+        return tuple(
+            np.stack([np.asarray(s[i]) for s in samples]) for i in range(len(first))
+        )
+    return np.stack([np.asarray(s) for s in samples])

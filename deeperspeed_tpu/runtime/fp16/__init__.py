@@ -1,0 +1,6 @@
+from .loss_scaler import (
+    DynamicLossScaler,
+    StaticLossScaler,
+    LossScaleState,
+    create_loss_scaler,
+)

@@ -1,0 +1,99 @@
+"""Static + dynamic loss scaling, as pure jit-compatible state transitions.
+
+Capability parity with /root/reference/deepspeed/runtime/fp16/loss_scaler.py
+(`LossScaler`, `DynamicLossScaler`): 2x growth per `scale_window` clean steps,
+/2 shrink on overflow with `delayed_shift` hysteresis and a `min_scale` floor.
+The reference mutates Python attributes per step; here the scaler is a small
+jnp state pytree updated inside the jitted train step so overflow handling
+costs no host round-trip.
+"""
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+
+class LossScaleState(NamedTuple):
+    loss_scale: jnp.ndarray  # f32 scalar
+    good_steps: jnp.ndarray  # i32 scalar — consecutive non-overflow steps
+    hysteresis: jnp.ndarray  # i32 scalar — remaining tolerated overflows
+
+
+class DynamicLossScaler:
+    def __init__(
+        self,
+        init_scale=2**32,
+        scale_factor=2.0,
+        scale_window=1000,
+        min_scale=1.0,
+        delayed_shift=1,
+        consecutive_hysteresis=False,
+    ):
+        self.init_scale = float(init_scale)
+        self.scale_factor = float(scale_factor)
+        self.scale_window = int(scale_window)
+        self.min_scale = float(min_scale)
+        self.delayed_shift = int(delayed_shift)
+        self.consecutive_hysteresis = consecutive_hysteresis
+        self.dynamic = True
+
+    def init(self) -> LossScaleState:
+        return LossScaleState(
+            loss_scale=jnp.asarray(self.init_scale, jnp.float32),
+            good_steps=jnp.zeros((), jnp.int32),
+            hysteresis=jnp.asarray(self.delayed_shift, jnp.int32),
+        )
+
+    def update(self, state: LossScaleState, overflow) -> LossScaleState:
+        """overflow: bool scalar array. Pure function of (state, overflow)."""
+        overflow = jnp.asarray(overflow)
+        # On overflow: consume hysteresis first; once exhausted, halve scale.
+        exhausted = state.hysteresis <= 1
+        shrunk = jnp.maximum(state.loss_scale / self.scale_factor, self.min_scale)
+        scale_after_overflow = jnp.where(exhausted, shrunk, state.loss_scale)
+        hysteresis_after_overflow = jnp.where(
+            exhausted, state.hysteresis, state.hysteresis - 1
+        )
+        # On a clean step: count up; at window boundary grow the scale.
+        good = state.good_steps + 1
+        grow = good % self.scale_window == 0
+        scale_after_good = jnp.where(
+            grow, state.loss_scale * self.scale_factor, state.loss_scale
+        )
+        hysteresis_after_good = (
+            jnp.asarray(self.delayed_shift, jnp.int32)
+            if self.consecutive_hysteresis
+            else state.hysteresis
+        )
+        return LossScaleState(
+            loss_scale=jnp.where(overflow, scale_after_overflow, scale_after_good),
+            good_steps=jnp.where(overflow, 0, good),
+            hysteresis=jnp.where(
+                overflow, hysteresis_after_overflow, hysteresis_after_good
+            ),
+        )
+
+
+class StaticLossScaler(DynamicLossScaler):
+    def __init__(self, scale=1.0):
+        super().__init__(init_scale=scale)
+        self.dynamic = False
+
+    def update(self, state: LossScaleState, overflow) -> LossScaleState:
+        return state
+
+
+def create_loss_scaler(precision, static_loss_scale=0, dynamic_args=None):
+    """Mirror of the engine's loss-scaler selection (reference
+    runtime/engine.py + fp16/loss_scaler.py): fp16 with loss_scale 0 =>
+    dynamic; otherwise static (bf16/fp32 default to static 1.0)."""
+    if precision == "fp16" and static_loss_scale == 0:
+        args = dynamic_args or {}
+        return DynamicLossScaler(
+            init_scale=args.get("init_scale", 2**32),
+            scale_window=args.get("scale_window", 1000),
+            delayed_shift=args.get("delayed_shift", 2),
+            min_scale=args.get("min_scale", 1.0),
+        )
+    scale = static_loss_scale if static_loss_scale else 1.0
+    return StaticLossScaler(scale=scale)

@@ -1,0 +1,30 @@
+"""Async-IO (NVMe swap) config block (schema parity with
+/root/reference/deepspeed/runtime/swap_tensor/aio_config.py)."""
+
+from ..config_utils import ConfigObject, get_scalar_param
+
+AIO = "aio"
+AIO_BLOCK_SIZE = "block_size"
+AIO_BLOCK_SIZE_DEFAULT = 1048576
+AIO_QUEUE_DEPTH = "queue_depth"
+AIO_QUEUE_DEPTH_DEFAULT = 8
+AIO_THREAD_COUNT = "thread_count"
+AIO_THREAD_COUNT_DEFAULT = 1
+AIO_SINGLE_SUBMIT = "single_submit"
+AIO_SINGLE_SUBMIT_DEFAULT = False
+AIO_OVERLAP_EVENTS = "overlap_events"
+AIO_OVERLAP_EVENTS_DEFAULT = True
+
+
+class AioConfig(ConfigObject):
+    def __init__(self, param_dict=None):
+        d = (param_dict or {}).get(AIO, {})
+        self.block_size = get_scalar_param(d, AIO_BLOCK_SIZE, AIO_BLOCK_SIZE_DEFAULT)
+        self.queue_depth = get_scalar_param(d, AIO_QUEUE_DEPTH, AIO_QUEUE_DEPTH_DEFAULT)
+        self.thread_count = get_scalar_param(d, AIO_THREAD_COUNT, AIO_THREAD_COUNT_DEFAULT)
+        self.single_submit = get_scalar_param(
+            d, AIO_SINGLE_SUBMIT, AIO_SINGLE_SUBMIT_DEFAULT
+        )
+        self.overlap_events = get_scalar_param(
+            d, AIO_OVERLAP_EVENTS, AIO_OVERLAP_EVENTS_DEFAULT
+        )

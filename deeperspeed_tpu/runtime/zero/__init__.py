@@ -1,0 +1,2 @@
+from .config import ZeroConfig
+from . import constants, partition

@@ -1,0 +1,166 @@
+"""Wall-clock + throughput timers.
+
+Capability parity with /root/reference/deepspeed/utils/timer.py:19,105
+(`SynchronizedWallClockTimer`, `ThroughputTimer`). "Synchronized" here means
+`jax.block_until_ready`-synchronized: the timer stops only after any arrays
+handed to `stop(sync_with=...)` are materialized on device (TPU dispatch is
+async, like CUDA streams).
+"""
+
+import time
+
+from .logging import log_dist
+
+
+def _device_sync(x=None):
+    try:
+        import jax
+
+        if x is not None:
+            jax.block_until_ready(x)
+        else:
+            # synchronize the default device by running a trivial computation
+            jax.device_get(jax.numpy.zeros(()))
+    except Exception:
+        pass
+
+
+class SynchronizedWallClockTimer:
+    """Named timers; elapsed() resets by default like the reference."""
+
+    class Timer:
+        def __init__(self, name):
+            self.name_ = name
+            self.elapsed_ = 0.0
+            self.started_ = False
+            self.start_time = time.time()
+
+        def start(self, sync=False):
+            assert not self.started_, f"timer {self.name_} has already been started"
+            if sync:
+                _device_sync()
+            self.start_time = time.time()
+            self.started_ = True
+
+        def stop(self, sync=False, sync_with=None):
+            assert self.started_, f"timer {self.name_} is not started"
+            if sync or sync_with is not None:
+                _device_sync(sync_with)
+            self.elapsed_ += time.time() - self.start_time
+            self.started_ = False
+
+        def reset(self):
+            self.elapsed_ = 0.0
+            self.started_ = False
+
+        def elapsed(self, reset=True):
+            started_ = self.started_
+            if self.started_:
+                self.stop()
+            elapsed_ = self.elapsed_
+            if reset:
+                self.reset()
+            if started_:
+                self.start()
+            return elapsed_
+
+        def mean(self):
+            return self.elapsed(reset=False)
+
+    def __init__(self):
+        self.timers = {}
+
+    def __call__(self, name):
+        if name not in self.timers:
+            self.timers[name] = self.Timer(name)
+        return self.timers[name]
+
+    @staticmethod
+    def memory_usage():
+        try:
+            import jax
+
+            stats = jax.local_devices()[0].memory_stats() or {}
+            alloc = stats.get("bytes_in_use", 0) / (1024**3)
+            peak = stats.get("peak_bytes_in_use", 0) / (1024**3)
+            return f"Memory: {alloc:.2f} GB in use | {peak:.2f} GB peak"
+        except Exception:
+            return "Memory: n/a"
+
+    def log(self, names, normalizer=1.0, reset=True, ranks=None):
+        assert normalizer > 0.0
+        string = "time (ms)"
+        for name in names:
+            if name in self.timers:
+                elapsed_time = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+                string += f" | {name}: {elapsed_time:.2f}"
+        log_dist(string, ranks=ranks or [0])
+
+
+class ThroughputTimer:
+    def __init__(self, batch_size, num_workers=1, start_step=2, steps_per_output=50,
+                 monitor_memory=False, logging_fn=None):
+        self.start_time = 0
+        self.end_time = 0
+        self.started = False
+        self.batch_size = max(1, batch_size)
+        self.num_workers = num_workers
+        self.start_step = start_step
+        self.epoch_count = 0
+        self.micro_step_count = 0
+        self.global_step_count = 0
+        self.total_elapsed_time = 0
+        self.step_elapsed_time = 0
+        self.steps_per_output = steps_per_output
+        self.monitor_memory = monitor_memory
+        self.logging = logging_fn or (lambda msg: log_dist(msg, ranks=[0]))
+        self.initialized = False
+
+    def update_epoch_count(self):
+        self.epoch_count += 1
+        self.micro_step_count = 0
+
+    def _init_timer(self):
+        self.initialized = True
+
+    def start(self):
+        self._init_timer()
+        self.started = True
+        if self.global_step_count >= self.start_step:
+            self.start_time = time.time()
+
+    def stop(self, global_step=False, report_speed=True, sync_with=None):
+        if not self.started:
+            return
+        self.started = False
+        self.micro_step_count += 1
+        if global_step:
+            self.global_step_count += 1
+        if self.start_time > 0:
+            _device_sync(sync_with)
+            self.end_time = time.time()
+            duration = self.end_time - self.start_time
+            self.total_elapsed_time += duration
+            self.step_elapsed_time += duration
+            if global_step and report_speed and (
+                self.global_step_count % self.steps_per_output == 0
+            ):
+                self.logging(
+                    "epoch={}/micro_step={}/global_step={}, "
+                    "RunningAvgSamplesPerSec={:.6g}, CurrSamplesPerSec={:.6g}".format(
+                        self.epoch_count,
+                        self.micro_step_count,
+                        self.global_step_count,
+                        self.avg_samples_per_sec(),
+                        self.batch_size / self.step_elapsed_time,
+                    )
+                )
+                self.step_elapsed_time = 0
+
+    def avg_samples_per_sec(self):
+        if self.global_step_count > self.start_step:
+            samples_per_step = self.batch_size * self.num_workers
+            total_step_offset = self.global_step_count - self.start_step
+            avg_time_per_step = self.total_elapsed_time / max(total_step_offset, 1)
+            return samples_per_step / max(avg_time_per_step, 1e-12)
+        return float("-inf")

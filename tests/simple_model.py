@@ -1,0 +1,74 @@
+"""Tiny real-model fixtures (the rebuild's analog of
+/root/reference/tests/unit/simple_model.py — SimpleModel, random_dataloader,
+args helpers)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def init_linear_stack(rng, dims):
+    """params for a stack of Linear layers: dims = [in, h1, ..., out]."""
+    params = {}
+    keys = jax.random.split(rng, len(dims) - 1)
+    for i, (d_in, d_out) in enumerate(zip(dims[:-1], dims[1:])):
+        params[f"layer_{i}"] = {
+            "w": jax.random.normal(keys[i], (d_in, d_out), jnp.float32)
+            / np.sqrt(d_in),
+            "b": jnp.zeros((d_out,), jnp.float32),
+        }
+    return params
+
+
+def linear_stack_loss(params, batch):
+    """MSE regression loss. batch = (x, y)."""
+    x, y = batch
+    h = x
+    n = len(params)
+    for i in range(n):
+        layer = params[f"layer_{i}"]
+        h = h @ layer["w"].astype(h.dtype) + layer["b"].astype(h.dtype)
+        if i < n - 1:
+            h = jax.nn.relu(h)
+    return jnp.mean((h.astype(jnp.float32) - y.astype(jnp.float32)) ** 2)
+
+
+class RandomDataset:
+    """Indexable dataset of (x, y) pairs."""
+
+    def __init__(self, n, d_in, d_out, seed=0):
+        rng = np.random.default_rng(seed)
+        self.x = rng.normal(size=(n, d_in)).astype(np.float32)
+        w = rng.normal(size=(d_in, d_out)).astype(np.float32) / np.sqrt(d_in)
+        self.y = (self.x @ w).astype(np.float32)
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return (self.x[i], self.y[i])
+
+
+def base_config(
+    micro_batch=4,
+    gas=1,
+    world=8,
+    lr=1e-2,
+    precision=None,
+    zero_stage=0,
+    optimizer="Adam",
+    **extra,
+):
+    cfg = {
+        "train_micro_batch_size_per_gpu": micro_batch,
+        "gradient_accumulation_steps": gas,
+        "steps_per_print": 1000,
+        "optimizer": {"type": optimizer, "params": {"lr": lr}},
+        "zero_optimization": {"stage": zero_stage},
+    }
+    if precision == "fp16":
+        cfg["fp16"] = {"enabled": True}
+    elif precision == "bf16":
+        cfg["fp16"] = {"enabled": True, "type": "bfloat16"}
+    cfg.update(extra)
+    return cfg

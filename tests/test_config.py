@@ -1,0 +1,177 @@
+"""Config parsing + batch triple derivation (parity with reference
+tests/unit/test_config.py, test_ds_config.py)."""
+
+import json
+
+import pytest
+
+from deeperspeed_tpu.runtime.config import ConfigError, TrainingConfig
+
+
+def test_batch_triple_all_given():
+    cfg = TrainingConfig(
+        {
+            "train_batch_size": 64,
+            "train_micro_batch_size_per_gpu": 4,
+            "gradient_accumulation_steps": 2,
+        },
+        world_size=8,
+    )
+    assert cfg.train_batch_size == 64
+    assert cfg.train_micro_batch_size_per_gpu == 4
+    assert cfg.gradient_accumulation_steps == 2
+
+
+def test_batch_triple_mismatch_raises():
+    with pytest.raises(AssertionError):
+        TrainingConfig(
+            {
+                "train_batch_size": 64,
+                "train_micro_batch_size_per_gpu": 4,
+                "gradient_accumulation_steps": 4,
+            },
+            world_size=8,
+        )
+
+
+def test_batch_derive_gas():
+    cfg = TrainingConfig(
+        {"train_batch_size": 64, "train_micro_batch_size_per_gpu": 4}, world_size=8
+    )
+    assert cfg.gradient_accumulation_steps == 2
+
+
+def test_batch_derive_micro():
+    cfg = TrainingConfig(
+        {"train_batch_size": 64, "gradient_accumulation_steps": 2}, world_size=8
+    )
+    assert cfg.train_micro_batch_size_per_gpu == 4
+
+
+def test_batch_only_train():
+    cfg = TrainingConfig({"train_batch_size": 64}, world_size=8)
+    assert cfg.train_micro_batch_size_per_gpu == 8
+    assert cfg.gradient_accumulation_steps == 1
+
+
+def test_batch_only_micro():
+    cfg = TrainingConfig({"train_micro_batch_size_per_gpu": 4}, world_size=8)
+    assert cfg.train_batch_size == 32
+    assert cfg.gradient_accumulation_steps == 1
+
+
+def test_batch_none_raises():
+    with pytest.raises(ConfigError):
+        TrainingConfig({}, world_size=8)
+
+
+def test_precision_selection():
+    assert TrainingConfig({"train_batch_size": 8}).precision == "fp32"
+    assert (
+        TrainingConfig({"train_batch_size": 8, "fp16": {"enabled": True}}).precision
+        == "fp16"
+    )
+    assert (
+        TrainingConfig(
+            {"train_batch_size": 8, "fp16": {"enabled": True, "type": "bfloat16"}}
+        ).precision
+        == "bfloat16"
+    )
+    assert (
+        TrainingConfig({"train_batch_size": 8, "bf16": {"enabled": True}}).precision
+        == "bfloat16"
+    )
+
+
+def test_bf16_defaults_to_unit_loss_scale():
+    cfg = TrainingConfig({"train_batch_size": 8, "bf16": {"enabled": True}})
+    assert cfg.loss_scale == 1.0
+    assert not cfg.dynamic_loss_scale
+
+
+def test_fp16_dynamic_loss_scale_args():
+    cfg = TrainingConfig(
+        {
+            "train_batch_size": 8,
+            "fp16": {
+                "enabled": True,
+                "loss_scale": 0,
+                "initial_scale_power": 16,
+                "loss_scale_window": 500,
+                "hysteresis": 3,
+                "min_loss_scale": 2,
+            },
+        }
+    )
+    assert cfg.dynamic_loss_scale
+    args = cfg.dynamic_loss_scale_args
+    assert args["init_scale"] == 2**16
+    assert args["scale_window"] == 500
+    assert args["delayed_shift"] == 3
+    assert args["min_scale"] == 2
+
+
+def test_zero_config_block():
+    cfg = TrainingConfig(
+        {
+            "train_batch_size": 8,
+            "zero_optimization": {
+                "stage": 2,
+                "reduce_bucket_size": 1000,
+                "offload_optimizer": {"device": "cpu"},
+            },
+        }
+    )
+    assert cfg.zero_enabled
+    assert cfg.zero_optimization_stage == 2
+    assert cfg.zero_config.reduce_bucket_size == 1000
+    assert cfg.zero_config.offload_optimizer.device == "cpu"
+
+
+def test_zero_legacy_bool():
+    cfg = TrainingConfig({"train_batch_size": 8, "zero_optimization": True})
+    assert cfg.zero_optimization_stage == 1
+
+
+def test_zero_bad_stage():
+    with pytest.raises(ValueError):
+        TrainingConfig({"train_batch_size": 8, "zero_optimization": {"stage": 9}})
+
+
+def test_config_from_json_file(tmp_path):
+    p = tmp_path / "ds_config.json"
+    p.write_text(json.dumps({"train_batch_size": 16, "fp16": {"enabled": True}}))
+    cfg = TrainingConfig(str(p), world_size=8)
+    assert cfg.train_batch_size == 16
+    assert cfg.precision == "fp16"
+
+
+def test_duplicate_json_keys_rejected(tmp_path):
+    p = tmp_path / "dup.json"
+    p.write_text('{"train_batch_size": 16, "train_batch_size": 32}')
+    with pytest.raises(ValueError):
+        TrainingConfig(str(p), world_size=8)
+
+
+def test_checkpoint_tag_validation_modes():
+    cfg = TrainingConfig(
+        {"train_batch_size": 8, "checkpoint": {"tag_validation": "FAIL"}}
+    )
+    assert cfg.checkpoint_tag_validation_fail
+    with pytest.raises(ConfigError):
+        TrainingConfig(
+            {"train_batch_size": 8, "checkpoint": {"tag_validation": "bogus"}}
+        )
+
+
+def test_scheduler_and_optimizer_blocks():
+    cfg = TrainingConfig(
+        {
+            "train_batch_size": 8,
+            "optimizer": {"type": "Adam", "params": {"lr": 0.1}},
+            "scheduler": {"type": "WarmupLR", "params": {"warmup_num_steps": 10}},
+        }
+    )
+    assert cfg.optimizer_name == "Adam"
+    assert cfg.optimizer_params["lr"] == 0.1
+    assert cfg.scheduler_name == "WarmupLR"

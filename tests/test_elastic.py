@@ -1,0 +1,121 @@
+"""Elasticity (parity with reference tests/unit/test_elastic.py)."""
+
+import pytest
+
+from deeperspeed_tpu.elasticity import (
+    ElasticityConfigError,
+    ElasticityIncompatibleWorldSize,
+    compute_elastic_config,
+)
+
+base_ds_config = {
+    "elasticity": {
+        "enabled": True,
+        "max_train_batch_size": 10000,
+        "micro_batch_sizes": [8, 12, 16, 17],
+        "min_gpus": 32,
+        "max_gpus": 1500,
+        "min_time": 20,
+        "version": 0.1,
+    }
+}
+
+
+def test_basic():
+    final_batch, valid_gpus = compute_elastic_config(base_ds_config)
+    assert final_batch <= 10000
+    assert len(valid_gpus) > 0
+    assert all(32 <= g <= 1500 for g in valid_gpus)
+    # every valid gpu count must divide cleanly for at least one micro batch
+    for g in valid_gpus:
+        assert any(
+            final_batch % (m * g) == 0
+            for m in base_ds_config["elasticity"]["micro_batch_sizes"]
+        )
+
+
+def test_world_size_resolution():
+    ws = 64
+    final_batch, valid_gpus, micro = compute_elastic_config(
+        base_ds_config, world_size=ws
+    )
+    assert ws in valid_gpus
+    assert final_batch % (micro * ws) == 0
+
+
+def test_incompatible_world_size():
+    cfg = {
+        "elasticity": {
+            "enabled": True,
+            "max_train_batch_size": 4,
+            "micro_batch_sizes": [2],
+            "min_gpus": 1,
+            "max_gpus": 4,
+            "version": 0.1,
+        }
+    }
+    with pytest.raises(ElasticityIncompatibleWorldSize):
+        compute_elastic_config(cfg, world_size=3)
+
+
+def test_missing_fields():
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config({"elasticity": {"enabled": True}})
+
+
+def test_bad_micro_batches():
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config(
+            {
+                "elasticity": {
+                    "enabled": True,
+                    "max_train_batch_size": 100,
+                    "micro_batch_sizes": [0, -1],
+                }
+            }
+        )
+
+
+def test_future_version_rejected():
+    cfg = dict(base_ds_config["elasticity"], version=99.0)
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config({"elasticity": cfg})
+
+
+def test_config_batch_rewrite():
+    from deeperspeed_tpu.runtime.config import TrainingConfig
+
+    ds = {
+        "elasticity": {
+            "enabled": True,
+            "max_train_batch_size": 1024,
+            "micro_batch_sizes": [2, 4],
+            "min_gpus": 1,
+            "max_gpus": 64,
+            "version": 0.1,
+        }
+    }
+    cfg = TrainingConfig(ds, world_size=8)
+    assert cfg.elasticity_enabled
+    assert (
+        cfg.train_batch_size
+        == cfg.train_micro_batch_size_per_gpu * cfg.gradient_accumulation_steps * 8
+    )
+
+
+def test_config_rejects_batch_params_with_elasticity():
+    from deeperspeed_tpu.runtime.config import ConfigError, TrainingConfig
+
+    ds = {
+        "train_batch_size": 64,
+        "elasticity": {
+            "enabled": True,
+            "max_train_batch_size": 1024,
+            "micro_batch_sizes": [2, 4],
+            "min_gpus": 1,
+            "max_gpus": 64,
+            "version": 0.1,
+        },
+    }
+    with pytest.raises(ConfigError):
+        TrainingConfig(ds, world_size=8)

@@ -1,0 +1,257 @@
+"""End-to-end engine tests on the 8-device CPU mesh (parity with reference
+tests/unit/test_fp16.py + test_checkpointing.py basics)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deeperspeed_tpu as ds
+from tests.simple_model import (
+    RandomDataset,
+    base_config,
+    init_linear_stack,
+    linear_stack_loss,
+)
+
+DIMS = [16, 32, 16]
+
+
+def make_engine(zero_stage=0, precision=None, gas=1, lr=1e-2, optimizer="Adam", **extra):
+    params = init_linear_stack(jax.random.PRNGKey(0), DIMS)
+    cfg = base_config(
+        micro_batch=4,
+        gas=gas,
+        lr=lr,
+        precision=precision,
+        zero_stage=zero_stage,
+        optimizer=optimizer,
+        **extra,
+    )
+    engine, _, _, _ = ds.initialize(
+        model=linear_stack_loss, model_parameters=params, config=cfg
+    )
+    return engine
+
+
+_DATASET = RandomDataset(512, DIMS[0], DIMS[-1], seed=0)
+
+
+def global_batch(engine, n_micro=1, seed=0):
+    """A deterministic slice of the shared dataset (seed picks the offset)."""
+    size = (
+        engine.train_micro_batch_size_per_gpu()
+        * engine.data_parallel_size
+        * n_micro
+    )
+    start = (seed * size) % (len(_DATASET) - size + 1)
+    idx = np.arange(start, start + size)
+    x = np.stack([_DATASET[i][0] for i in idx])
+    y = np.stack([_DATASET[i][1] for i in idx])
+    return (x, y)
+
+
+def train_steps(engine, steps=10, seed=0):
+    gas = engine.gradient_accumulation_steps()
+    losses = []
+    for s in range(steps):
+        batch = global_batch(engine, n_micro=gas, seed=seed + s)
+        loss = engine.train_batch(batch)
+        losses.append(float(jax.device_get(loss)))
+    return losses
+
+
+def test_train_loss_decreases():
+    engine = make_engine()
+    losses = train_steps(engine, steps=20, seed=42)
+    assert losses[-1] < losses[0] * 0.5, losses
+
+
+@pytest.mark.parametrize("stage", [0, 1, 2, 3])
+def test_zero_stages_match_stage0(stage):
+    """All ZeRO stages must produce numerically equivalent training."""
+    ref = make_engine(zero_stage=0)
+    ref_losses = train_steps(ref, steps=5, seed=7)
+    eng = make_engine(zero_stage=stage)
+    losses = train_steps(eng, steps=5, seed=7)
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-4)
+    # final params identical too
+    p_ref = jax.device_get(ref.state.params)
+    p_new = jax.device_get(eng.state.params)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5),
+        p_ref,
+        p_new,
+    )
+
+
+@pytest.mark.parametrize("stage", [1, 2, 3])
+def test_zero_state_is_sharded(stage):
+    engine = make_engine(zero_stage=stage, precision="bf16")
+    # the largest master leaf must be sharded over the data axis
+    w = engine.state.master["layer_0"]["w"]
+    shardings = {s for s in w.sharding.spec}
+    assert "data" in shardings
+    if stage >= 3:
+        wp = engine.state.params["layer_0"]["w"]
+        assert "data" in set(wp.sharding.spec)
+
+
+def test_bf16_training():
+    engine = make_engine(precision="bf16", zero_stage=2)
+    losses = train_steps(engine, steps=20, seed=3)
+    assert losses[-1] < losses[0] * 0.6
+    assert engine.state.params["layer_0"]["w"].dtype == jnp.bfloat16
+    assert engine.state.master["layer_0"]["w"].dtype == jnp.float32
+
+
+def test_gradient_accumulation_equivalence():
+    """gas=2 over a batch must equal gas=1 with doubled micro batch (both see
+    the same samples in one optimizer step)."""
+    params = init_linear_stack(jax.random.PRNGKey(0), DIMS)
+    cfg_gas = base_config(micro_batch=4, gas=2, lr=1e-2)
+    cfg_big = base_config(micro_batch=8, gas=1, lr=1e-2)
+    e_gas, _, _, _ = ds.initialize(
+        model=linear_stack_loss, model_parameters=params, config=cfg_gas
+    )
+    e_big, _, _, _ = ds.initialize(
+        model=linear_stack_loss, model_parameters=params, config=cfg_big
+    )
+    for s in range(3):
+        batch = global_batch(e_big, n_micro=1, seed=100 + s)  # 64 samples
+        e_gas.train_batch(batch)
+        e_big.train_batch(batch)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            jax.device_get(a), jax.device_get(b), rtol=1e-4, atol=1e-6
+        ),
+        e_gas.state.params,
+        e_big.state.params,
+    )
+
+
+def test_forward_backward_step_api():
+    engine = make_engine(gas=2)
+    losses = []
+    for s in range(8):
+        batch = global_batch(engine, n_micro=1, seed=200 + s)
+        loss = engine(batch)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(jax.device_get(loss)))
+    assert engine.global_steps == 4  # gas=2 -> an optimizer step every 2 micros
+    assert losses[-1] < losses[0]
+
+
+def test_eval_mode_no_update():
+    engine = make_engine()
+    p0 = jax.device_get(engine.state.params["layer_0"]["w"])
+    engine.eval()
+    batch = global_batch(engine)
+    loss = engine(batch)
+    assert np.isfinite(float(jax.device_get(loss)))
+    p1 = jax.device_get(engine.state.params["layer_0"]["w"])
+    np.testing.assert_array_equal(p0, p1)
+
+
+def test_lamb_optimizer():
+    engine = make_engine(optimizer="Lamb", lr=2e-2)
+    losses = train_steps(engine, steps=30, seed=5)
+    assert losses[-1] < losses[0] * 0.7
+
+
+def test_sgd_optimizer():
+    engine = make_engine(optimizer="SGD", lr=5e-2)
+    losses = train_steps(engine, steps=30, seed=5)
+    assert losses[-1] < losses[0] * 0.9
+
+
+def test_scheduler_steps():
+    engine = make_engine(
+        scheduler={
+            "type": "WarmupLR",
+            "params": {"warmup_min_lr": 0.0, "warmup_max_lr": 0.01, "warmup_num_steps": 10},
+        }
+    )
+    lr0 = engine.get_lr()[0]
+    train_steps(engine, steps=5)
+    lr5 = engine.get_lr()[0]
+    assert lr5 > lr0
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    engine = make_engine(zero_stage=2, precision="bf16")
+    train_steps(engine, steps=5, seed=11)
+    engine.save_checkpoint(str(tmp_path), client_state={"note": "hello"})
+
+    # fresh engine, load, continue — states must match
+    engine2 = make_engine(zero_stage=2, precision="bf16")
+    path, client = engine2.load_checkpoint(str(tmp_path))
+    assert path is not None
+    assert client["note"] == "hello"
+    assert engine2.global_steps == engine.global_steps
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(jax.device_get(a), jax.device_get(b)),
+        engine.state.params,
+        engine2.state.params,
+    )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(jax.device_get(a), jax.device_get(b)),
+        engine.state.opt_state.exp_avg,
+        engine2.state.opt_state.exp_avg,
+    )
+    # training continues identically
+    l1 = train_steps(engine, steps=3, seed=12)
+    l2 = train_steps(engine2, steps=3, seed=12)
+    np.testing.assert_allclose(l1, l2, rtol=1e-5)
+
+
+def test_checkpoint_latest_tag(tmp_path):
+    engine = make_engine()
+    train_steps(engine, steps=2)
+    engine.save_checkpoint(str(tmp_path), tag="tag_a")
+    engine.save_checkpoint(str(tmp_path), tag="tag_b")
+    from deeperspeed_tpu.checkpoint import read_latest
+
+    assert read_latest(str(tmp_path)) == "tag_b"
+
+
+def test_zero_to_fp32_consolidation(tmp_path):
+    engine = make_engine(zero_stage=2, precision="bf16")
+    train_steps(engine, steps=3)
+    engine.save_checkpoint(str(tmp_path), tag="final")
+    from deeperspeed_tpu.checkpoint import consolidate_fp32_state
+
+    fp32 = consolidate_fp32_state(str(tmp_path / "final"))
+    ref = jax.device_get(engine.state.master)
+    got = np.asarray(jax.tree.leaves(fp32)[0])
+    want = np.asarray(jax.tree.leaves(ref)[0])
+    np.testing.assert_allclose(got, want)
+
+
+def test_onebit_adam_optimizer():
+    engine = make_engine(optimizer="OneBitAdam", lr=1e-2)
+    losses = train_steps(engine, steps=20, seed=9)
+    assert losses[-1] < losses[0] * 0.6
+
+
+def test_onebit_adam_compression_phase():
+    """After freeze_step the variance freezes and momentum is 1-bit
+    compressed; training must still make progress."""
+    params = init_linear_stack(jax.random.PRNGKey(0), DIMS)
+    cfg = base_config(micro_batch=4, lr=5e-3)
+    cfg["optimizer"] = {
+        "type": "OneBitAdam",
+        "params": {"lr": 5e-3, "freeze_step": 3},
+    }
+    engine, _, _, _ = ds.initialize(
+        model=linear_stack_loss, model_parameters=params, config=cfg
+    )
+    losses = train_steps(engine, steps=25, seed=9)
+    assert losses[-1] < losses[0]
+    v_before = jax.device_get(engine.state.opt_state.exp_avg_sq)
+    train_steps(engine, steps=2, seed=50)
+    v_after = jax.device_get(engine.state.opt_state.exp_avg_sq)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(a, b), v_before, v_after
+    )

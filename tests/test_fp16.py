@@ -1,0 +1,95 @@
+"""Loss scaling + overflow handling (parity with reference
+tests/unit/test_fp16.py + test_dynamic_loss_scale.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeperspeed_tpu.runtime.fp16.loss_scaler import (
+    DynamicLossScaler,
+    StaticLossScaler,
+    create_loss_scaler,
+)
+from tests.test_engine import global_batch, make_engine, train_steps
+
+
+def run_updates(scaler, state, overflows):
+    for ov in overflows:
+        state = scaler.update(state, jnp.asarray(ov))
+    return state
+
+
+def test_dynamic_scaler_grows_at_window():
+    s = DynamicLossScaler(init_scale=2**8, scale_window=4, delayed_shift=1)
+    st = s.init()
+    st = run_updates(s, st, [False] * 4)
+    assert float(st.loss_scale) == 2**9
+    assert int(st.good_steps) == 4
+
+
+def test_dynamic_scaler_shrinks_on_overflow():
+    s = DynamicLossScaler(init_scale=2**8, scale_window=1000, delayed_shift=1)
+    st = s.init()
+    st = run_updates(s, st, [True])
+    assert float(st.loss_scale) == 2**7
+    assert int(st.good_steps) == 0
+
+
+def test_dynamic_scaler_hysteresis():
+    s = DynamicLossScaler(init_scale=2**8, scale_window=1000, delayed_shift=2)
+    st = s.init()
+    st = run_updates(s, st, [True])  # first overflow eats hysteresis
+    assert float(st.loss_scale) == 2**8
+    st = run_updates(s, st, [True])  # second halves
+    assert float(st.loss_scale) == 2**7
+
+
+def test_dynamic_scaler_min_scale():
+    s = DynamicLossScaler(init_scale=2.0, scale_window=1000, delayed_shift=1, min_scale=1.0)
+    st = s.init()
+    st = run_updates(s, st, [True, True, True])
+    assert float(st.loss_scale) == 1.0
+
+
+def test_static_scaler_never_changes():
+    s = StaticLossScaler(scale=128.0)
+    st = s.init()
+    st = run_updates(s, st, [True, False, True])
+    assert float(st.loss_scale) == 128.0
+
+
+def test_create_scaler_selection():
+    assert create_loss_scaler("fp16", static_loss_scale=0).dynamic
+    assert not create_loss_scaler("fp16", static_loss_scale=128).dynamic
+    assert not create_loss_scaler("bfloat16", static_loss_scale=1.0).dynamic
+
+
+def test_fp16_training_converges():
+    engine = make_engine(
+        precision="fp16",
+        zero_stage=1,
+        fp16={"enabled": True, "initial_scale_power": 8},
+    )
+    losses = train_steps(engine, steps=20, seed=2)
+    assert losses[-1] < losses[0] * 0.7
+    assert engine.state.params["layer_0"]["w"].dtype == jnp.float16
+
+
+def test_overflow_skips_step_and_halves_scale():
+    engine = make_engine(
+        precision="fp16",
+        zero_stage=0,
+        fp16={"enabled": True, "initial_scale_power": 4, "hysteresis": 1},
+    )
+    p0 = np.asarray(jax.device_get(engine.state.master["layer_0"]["w"]))
+    scale0 = engine.loss_scale()
+    x, y = global_batch(engine)
+    x = x.copy()
+    x[0, 0] = np.inf  # poison one sample -> non-finite grads
+    engine.train_batch((x, y))
+    assert engine.skipped_steps == 1
+    assert engine.loss_scale() == scale0 / 2
+    p1 = np.asarray(jax.device_get(engine.state.master["layer_0"]["w"]))
+    np.testing.assert_array_equal(p0, p1)  # update skipped
+    # optimizer step counter unchanged
+    assert int(jax.device_get(engine.state.step)) == 0

@@ -1,0 +1,86 @@
+"""Topology grid math (parity with reference tests/unit/test_topology.py)."""
+
+import jax
+import pytest
+
+from deeperspeed_tpu.parallel.topology import (
+    PipeDataParallelTopology,
+    PipeModelDataParallelTopology,
+    PipelineParallelGrid,
+    ProcessTopology,
+    build_mesh,
+)
+
+
+def test_topology_2d():
+    topo = ProcessTopology(axes=["row", "col"], dims=[2, 2])
+    assert topo.world_size() == 4
+    assert topo.get_rank(row=0, col=0) == 0
+    assert topo.get_rank(row=0, col=1) == 1
+    assert topo.get_rank(row=1, col=0) == 2
+    assert topo.get_rank(row=1, col=1) == 3
+    assert topo.get_coord(2) == topo.ProcessCoord(row=1, col=0)
+
+
+def test_topology_dims():
+    topo = ProcessTopology(axes=["a", "b", "c"], dims=[2, 3, 4])
+    assert topo.world_size() == 24
+    assert topo.get_dim("b") == 3
+    assert topo.get_dim("missing") == 0
+
+
+def test_topology_comm_lists():
+    topo = PipeDataParallelTopology(num_pp=2, num_dp=2)
+    assert topo.get_axis_comm_lists("pipe") == [[0, 2], [1, 3]]
+    assert topo.get_axis_comm_lists("data") == [[0, 1], [2, 3]]
+
+
+def test_topology_filter_match():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+    # all ranks in pipe stage 0
+    stage0 = topo.filter_match(pipe=0)
+    assert len(stage0) == 4
+    assert all(topo.get_coord(r).pipe == 0 for r in stage0)
+
+
+def test_topology_axis_list():
+    topo = PipeDataParallelTopology(num_pp=2, num_dp=4)
+    assert topo.get_axis_list("pipe", 0) == [0, 1, 2, 3]
+    assert topo.get_axis_list("data", 1) == [1, 5]
+
+
+def test_rank_repr():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=1)
+    # default omits data/pipe axes
+    assert topo.get_rank_repr(rank=0) == "model_00"
+
+
+def test_grid():
+    topo = PipeDataParallelTopology(num_pp=2, num_dp=4)
+    grid = PipelineParallelGrid(topo, global_rank=5)
+    assert grid.pipe_parallel_size == 2
+    assert grid.data_parallel_size == 4
+    assert grid.get_stage_id() == 1
+    assert grid.get_data_parallel_id() == 1
+    assert not grid.is_first_stage()
+    assert grid.is_last_stage()
+    assert grid.stage_to_global_rank(0) == 1
+
+
+def test_build_mesh_infers_dim():
+    mesh = build_mesh({"data": -1})
+    assert mesh.shape["data"] == len(jax.devices())
+
+
+def test_build_mesh_2d():
+    n = len(jax.devices())
+    if n % 2:
+        pytest.skip("needs even device count")
+    mesh = build_mesh({"data": n // 2, "model": 2})
+    assert mesh.shape["data"] == n // 2
+    assert mesh.shape["model"] == 2
+
+
+def test_build_mesh_bad_dims():
+    with pytest.raises(ValueError):
+        build_mesh({"data": 3, "model": 5})
