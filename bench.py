@@ -1,0 +1,124 @@
+"""Benchmark: GPT-2/NeoX 125M-class training throughput on one chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+vs_baseline compares achieved MFU against the reference's published peak
+efficiency: DeeperSpeed's headline BERT kernel numbers are 52% of V100 peak
+(/root/reference/docs/_posts/2020-05-19-bert-record.md:14, BASELINE.md).
+vs_baseline = our_MFU / 0.52 — >1.0 means beating the reference's
+hardware-efficiency bar on TPU.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+# bf16 peak TFLOPS per chip by generation (public spec sheets)
+PEAK_TFLOPS = {
+    "v4": 275.0,
+    "v5e": 197.0,
+    "v5p": 459.0,
+    "v6e": 918.0,
+    "cpu": 0.5,  # nominal, so the script still runs off-TPU
+}
+REFERENCE_MFU = 0.52
+
+
+def chip_peak_tflops():
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
+    for key in PEAK_TFLOPS:
+        if gen.startswith(key):
+            return PEAK_TFLOPS[key]
+    import jax
+
+    plat = jax.devices()[0].platform
+    if plat == "tpu":
+        return PEAK_TFLOPS["v5e"]
+    return PEAK_TFLOPS["cpu"]
+
+
+def transformer_flops_per_token(cfg, seq):
+    """TOTAL training flops per token (fwd 2N + bwd 4N = 6N, plus the
+    attention matmul term 12*L*D*S which likewise counts fwd+bwd)."""
+    D, L, F, V = cfg.d_model, cfg.n_layer, cfg.ffn_dim, cfg.vocab_size
+    n_params = L * (4 * D * D + 2 * D * F) + D * V
+    return 6.0 * n_params + 12.0 * L * D * seq
+
+
+def main():
+    import jax
+
+    import deeperspeed_tpu as ds
+    from deeperspeed_tpu.models.gpt import GPTConfig, make_gpt
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        cfg = GPTConfig(
+            vocab_size=50304, n_layer=12, n_head=12, d_model=768, max_seq=1024,
+            remat=False,  # flash attention keeps activations O(S); 125M fits
+        )
+        micro, seq, steps, warmup = 8, 1024, 20, 3
+    else:  # smoke mode off-TPU
+        cfg = GPTConfig(
+            vocab_size=1024, n_layer=2, n_head=4, d_model=128, max_seq=128,
+            attn_impl="xla",
+        )
+        micro, seq, steps, warmup = 4, 128, 5, 2
+
+    init_fn, _, loss_fn, _ = make_gpt(cfg)
+    params = init_fn(jax.random.PRNGKey(0))
+    ds_cfg = {
+        "train_micro_batch_size_per_gpu": micro,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+        "fp16": {"enabled": True, "type": "bfloat16"},
+        "zero_optimization": {"stage": 1},
+        "gradient_clipping": 1.0,
+        "steps_per_print": 10**9,
+    }
+    engine, _, _, _ = ds.initialize(
+        model=loss_fn, model_parameters=params, config=ds_cfg
+    )
+    dp = engine.data_parallel_size
+    rng = np.random.default_rng(0)
+    batch = rng.integers(0, cfg.vocab_size, size=(micro * dp, seq + 1), dtype=np.int32)
+
+    for _ in range(warmup):
+        loss = engine.train_batch(batch)
+    # device_get is the only reliable barrier on the axon-tunneled platform
+    float(jax.device_get(loss))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = engine.train_batch(batch)
+    float(jax.device_get(loss))
+    dt = (time.perf_counter() - t0) / steps
+
+    tokens_per_step = micro * dp * seq
+    tokens_per_sec_per_chip = tokens_per_step / dt / max(1, len(jax.devices()))
+    flops_per_token = transformer_flops_per_token(cfg, seq)  # already total
+    model_tflops = tokens_per_sec_per_chip * flops_per_token / 1e12
+    mfu = model_tflops / chip_peak_tflops()
+    print(
+        json.dumps(
+            {
+                "metric": "gpt_125m_tokens_per_sec_per_chip",
+                "value": round(tokens_per_sec_per_chip, 1),
+                "unit": "tokens/s/chip",
+                "vs_baseline": round(mfu / REFERENCE_MFU, 4),
+                "detail": {
+                    "step_time_s": round(dt, 4),
+                    "model_tflops_per_chip": round(model_tflops, 2),
+                    "mfu": round(mfu, 4),
+                    "loss": round(float(jax.device_get(loss)), 4),
+                    "platform": jax.devices()[0].platform,
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,353 @@
+"""GPT / GPT-NeoX decoder-only transformer, TPU-native.
+
+This is the flagship model family the reference framework was built to train
+(GPT-NeeoX used DeeperSpeed's PipelineModule + Megatron mpu; see SURVEY §1).
+Design is jax-first rather than a port:
+
+  * params are a plain pytree with per-layer tensors STACKED on a leading
+    layer axis, so the forward is a `lax.scan` over layers — this is what
+    makes ZeRO-3 parameter gathering per-layer (XLA all-gathers each layer's
+    slice inside the scan, the analog of stage3's fetch/release hooks) and
+    keeps compile time O(1) in depth.
+  * `jax.checkpoint` (remat) per scan step == activation checkpointing with
+    checkpoint_interval=1 (reference activation_checkpointing/checkpointing.py).
+  * tensor parallelism is a PartitionSpec pytree over the 'model' axis
+    (attention heads / ffn columns), the native replacement for the external
+    Megatron mpu the reference consumed (engine.py:630-641).
+  * sequence-axis sharding constraints give context-parallel long-sequence
+    training over the 'seq' mesh axis.
+
+Supports GPT-2 (learned positions, serial residual) and GPT-NeoX (rotary,
+parallel attention+MLP residual) variants.
+"""
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.topology import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50304
+    n_layer: int = 12
+    n_head: int = 12
+    d_model: int = 768
+    d_ff: int = 0  # 0 => 4 * d_model
+    max_seq: int = 1024
+    rotary: bool = True  # NeoX-style rotary; False => learned positions
+    rotary_pct: float = 1.0
+    parallel_residual: bool = True  # NeoX parallel attn+mlp
+    layernorm_eps: float = 1e-5
+    tie_embeddings: bool = False
+    remat: bool = True
+    dtype: Any = jnp.bfloat16  # compute dtype for activations
+    attn_impl: str = "auto"  # 'auto' | 'pallas' | 'xla'
+
+    @property
+    def ffn_dim(self):
+        return self.d_ff if self.d_ff else 4 * self.d_model
+
+    @property
+    def head_dim(self):
+        assert self.d_model % self.n_head == 0
+        return self.d_model // self.n_head
+
+
+# ------------------------------------------------------------------ #
+# init
+# ------------------------------------------------------------------ #
+
+
+def init_params(rng, cfg: GPTConfig):
+    """Initial fp32 params. Per-layer tensors stacked on axis 0."""
+    D, F, L, V = cfg.d_model, cfg.ffn_dim, cfg.n_layer, cfg.vocab_size
+    k = iter(jax.random.split(rng, 16))
+    std = 0.02
+    # output projections scaled by 1/sqrt(2L) (GPT-2/NeoX convention)
+    out_std = std / math.sqrt(2.0 * L)
+
+    def norm(key, shape, s):
+        return (jax.random.normal(key, shape, jnp.float32) * s).astype(jnp.float32)
+
+    params = {
+        "embed": {"wte": norm(next(k), (V, D), std)},
+        "layers": {
+            "ln1_scale": jnp.ones((L, D), jnp.float32),
+            "ln1_bias": jnp.zeros((L, D), jnp.float32),
+            "ln2_scale": jnp.ones((L, D), jnp.float32),
+            "ln2_bias": jnp.zeros((L, D), jnp.float32),
+            "attn": {
+                "wqkv": norm(next(k), (L, D, 3 * D), std),
+                "bqkv": jnp.zeros((L, 3 * D), jnp.float32),
+                "wo": norm(next(k), (L, D, D), out_std),
+                "bo": jnp.zeros((L, D), jnp.float32),
+            },
+            "mlp": {
+                "wi": norm(next(k), (L, D, F), std),
+                "bi": jnp.zeros((L, F), jnp.float32),
+                "wo": norm(next(k), (L, F, D), out_std),
+                "bo": jnp.zeros((L, D), jnp.float32),
+            },
+        },
+        "final_ln": {
+            "scale": jnp.ones((D,), jnp.float32),
+            "bias": jnp.zeros((D,), jnp.float32),
+        },
+    }
+    if not cfg.rotary:
+        params["embed"]["wpe"] = norm(next(k), (cfg.max_seq, D), std)
+    if not cfg.tie_embeddings:
+        params["lm_head"] = norm(next(k), (D, V), std)
+    return params
+
+
+def param_specs(cfg: GPTConfig):
+    """Tensor-parallel PartitionSpecs over the 'model' axis (megatron-style
+    column/row split: qkv+ffn-in column-parallel, attn-out+ffn-out
+    row-parallel, embeddings vocab-sharded)."""
+    M = MODEL_AXIS
+    specs = {
+        # wte sharded over d_model, not vocab: XLA's sharded-gather from a
+        # vocab-sharded table falls back to full replication (SPMD warning),
+        # while column-sharded embedding rows gather cleanly
+        "embed": {"wte": P(None, M)},
+        "layers": {
+            "ln1_scale": P(None, None),
+            "ln1_bias": P(None, None),
+            "ln2_scale": P(None, None),
+            "ln2_bias": P(None, None),
+            "attn": {
+                "wqkv": P(None, None, M),
+                "bqkv": P(None, M),
+                "wo": P(None, M, None),
+                "bo": P(None, None),
+            },
+            "mlp": {
+                "wi": P(None, None, M),
+                "bi": P(None, M),
+                "wo": P(None, M, None),
+                "bo": P(None, None),
+            },
+        },
+        "final_ln": {"scale": P(None), "bias": P(None)},
+    }
+    if not cfg.rotary:
+        specs["embed"]["wpe"] = P(None, None)
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = P(None, M)
+    return specs
+
+
+# ------------------------------------------------------------------ #
+# building blocks
+# ------------------------------------------------------------------ #
+
+
+def layer_norm(x, scale, bias, eps):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mu), axis=-1, keepdims=True)
+    y = (x32 - mu) * jax.lax.rsqrt(var + eps)
+    return (y * scale + bias).astype(x.dtype)
+
+
+def rotary_embedding(x, positions, rotary_dims):
+    """Apply rotary position embedding to the first rotary_dims of head_dim.
+
+    x: (B, S, H, Dh); positions: (S,)"""
+    dh = x.shape[-1]
+    rot, rest = x[..., :rotary_dims], x[..., rotary_dims:]
+    half = rotary_dims // 2
+    freq = jnp.exp(
+        -math.log(10000.0) * jnp.arange(0, half, dtype=jnp.float32) / half
+    )
+    angles = positions[:, None].astype(jnp.float32) * freq[None, :]  # (S, half)
+    cos = jnp.cos(angles)[None, :, None, :].astype(x.dtype)
+    sin = jnp.sin(angles)[None, :, None, :].astype(x.dtype)
+    x1, x2 = rot[..., :half], rot[..., half:]
+    rotated = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    if rest.shape[-1]:
+        return jnp.concatenate([rotated, rest], axis=-1)
+    return rotated
+
+
+def _xla_causal_attention(q, k, v):
+    """Reference attention; XLA fuses this well on the MXU. (B,S,H,Dh)."""
+    dh = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+    scores = scores / math.sqrt(dh)
+    s_q, s_k = q.shape[1], k.shape[1]
+    mask = jnp.tril(jnp.ones((s_q, s_k), bool))
+    scores = jnp.where(mask[None, None, :, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def causal_attention(q, k, v, impl="auto"):
+    if impl in ("auto", "pallas", "pallas_interpret"):
+        from ..ops.pallas.flash_attention import flash_attention, is_available
+
+        if impl == "pallas_interpret":  # CPU testing path
+            return flash_attention(q, k, v, causal=True, interpret=True)
+        if impl == "pallas" or is_available(q):
+            return flash_attention(q, k, v, causal=True)
+    return _xla_causal_attention(q, k, v)
+
+
+# ------------------------------------------------------------------ #
+# forward
+# ------------------------------------------------------------------ #
+
+
+def _shard_act(x, mesh, spec):
+    if mesh is None:
+        return x
+    # drop axis names the mesh doesn't have (e.g. 'seq' on a dp x tp mesh)
+    parts = tuple(
+        a if (a is not None and a in mesh.shape and mesh.shape[a] > 1) else None
+        for a in tuple(spec)
+    )
+    from jax.sharding import NamedSharding
+
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*parts)))
+
+
+def make_gpt(cfg: GPTConfig, mesh=None):
+    """Returns (init_fn, apply_fn, loss_fn, specs).
+
+    apply_fn(params, tokens) -> logits (B, S, V)
+    loss_fn(params, batch) with batch = tokens (B, S+1) or (inputs, targets)
+    """
+
+    def block(carry, layer_params, positions):
+        x = carry  # (B, S, D) compute dtype
+        cdt = cfg.dtype
+        attn_in = layer_norm(
+            x, layer_params["ln1_scale"], layer_params["ln1_bias"], cfg.layernorm_eps
+        )
+        B, S, D = x.shape
+        H, Dh = cfg.n_head, cfg.head_dim
+        qkv = attn_in @ layer_params["attn"]["wqkv"].astype(cdt) + layer_params[
+            "attn"
+        ]["bqkv"].astype(cdt)
+        qkv = qkv.reshape(B, S, 3, H, Dh)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if cfg.rotary:
+            rd = int(cfg.rotary_pct * Dh) // 2 * 2
+            q = rotary_embedding(q, positions, rd)
+            k = rotary_embedding(k, positions, rd)
+        q = _shard_act(q, mesh, P(DATA_AXIS, SEQ_AXIS, MODEL_AXIS, None))
+        k = _shard_act(k, mesh, P(DATA_AXIS, SEQ_AXIS, MODEL_AXIS, None))
+        v = _shard_act(v, mesh, P(DATA_AXIS, SEQ_AXIS, MODEL_AXIS, None))
+        attn = causal_attention(q, k, v, impl=cfg.attn_impl)
+        attn = attn.reshape(B, S, D)
+        attn_out = attn @ layer_params["attn"]["wo"].astype(cdt) + layer_params[
+            "attn"
+        ]["bo"].astype(cdt)
+
+        if cfg.parallel_residual:
+            # NeoX: x + attn(ln1(x)) + mlp(ln2(x))
+            mlp_in = layer_norm(
+                x,
+                layer_params["ln2_scale"],
+                layer_params["ln2_bias"],
+                cfg.layernorm_eps,
+            )
+        else:
+            x = x + attn_out
+            mlp_in = layer_norm(
+                x,
+                layer_params["ln2_scale"],
+                layer_params["ln2_bias"],
+                cfg.layernorm_eps,
+            )
+        h = mlp_in @ layer_params["mlp"]["wi"].astype(cdt) + layer_params["mlp"][
+            "bi"
+        ].astype(cdt)
+        h = jax.nn.gelu(h, approximate=True)
+        h = _shard_act(h, mesh, P(DATA_AXIS, SEQ_AXIS, MODEL_AXIS))
+        mlp_out = h @ layer_params["mlp"]["wo"].astype(cdt) + layer_params["mlp"][
+            "bo"
+        ].astype(cdt)
+
+        if cfg.parallel_residual:
+            x = x + attn_out + mlp_out
+        else:
+            x = x + mlp_out
+        x = _shard_act(x, mesh, P(DATA_AXIS, SEQ_AXIS, None))
+        return x
+
+    def apply_fn(params, tokens):
+        """tokens (B, S) int32 -> logits (B, S, V)."""
+        cdt = cfg.dtype
+        B, S = tokens.shape
+        wte = params["embed"]["wte"].astype(cdt)
+        x = jnp.take(wte, tokens, axis=0)  # (B, S, D)
+        positions = jnp.arange(S, dtype=jnp.int32)
+        if not cfg.rotary:
+            x = x + params["embed"]["wpe"][:S].astype(cdt)
+        x = _shard_act(x, mesh, P(DATA_AXIS, SEQ_AXIS, None))
+
+        step = partial(block, positions=positions)
+        if cfg.remat:
+            step = jax.checkpoint(step, prevent_cse=False)
+
+        def scan_body(carry, layer_params):
+            return step(carry, layer_params), None
+
+        x, _ = jax.lax.scan(scan_body, x, params["layers"])
+        x = layer_norm(
+            x, params["final_ln"]["scale"], params["final_ln"]["bias"], cfg.layernorm_eps
+        )
+        if cfg.tie_embeddings:
+            logits = x @ params["embed"]["wte"].astype(cdt).T
+        else:
+            logits = x @ params["lm_head"].astype(cdt)
+        return logits
+
+    def loss_fn(params, batch):
+        """batch: (inputs, targets) int (B, S) each, or tokens (B, S+1)."""
+        if isinstance(batch, (tuple, list)):
+            inputs, targets = batch
+        else:
+            inputs, targets = batch[:, :-1], batch[:, 1:]
+        logits = apply_fn(params, inputs).astype(jnp.float32)
+        # nll = logsumexp - target_logit, WITHOUT materializing the fp32
+        # log-softmax over the full (B, S, V) tensor (pure HBM traffic)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+        return jnp.mean(lse - tgt)
+
+    def init_fn(rng):
+        return init_params(rng, cfg)
+
+    return init_fn, apply_fn, loss_fn, param_specs(cfg)
+
+
+# convenience presets ------------------------------------------------- #
+
+PRESETS = {
+    "gpt2-125m": GPTConfig(n_layer=12, n_head=12, d_model=768, rotary=False,
+                           parallel_residual=False),
+    "gpt2-350m": GPTConfig(n_layer=24, n_head=16, d_model=1024, rotary=False,
+                           parallel_residual=False),
+    "neox-125m": GPTConfig(n_layer=12, n_head=12, d_model=768),
+    "neox-1.3b": GPTConfig(n_layer=24, n_head=16, d_model=2048),
+    "neox-6.7b": GPTConfig(n_layer=32, n_head=32, d_model=4096),
+    "neox-20b": GPTConfig(
+        n_layer=44, n_head=64, d_model=6144, d_ff=24576, vocab_size=50432,
+        rotary_pct=0.25,
+    ),
+}
+
+
+def get_preset(name: str, **overrides) -> GPTConfig:
+    cfg = PRESETS[name]
+    return dataclasses.replace(cfg, **overrides) if overrides else cfg

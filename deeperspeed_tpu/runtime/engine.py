@@ -122,12 +122,11 @@ class Engine:
         self.global_steps = 0
         self.global_samples = 0
         self.micro_steps = 0
-        self.skipped_steps = 0
         self._mode = "train"
         self._stashed = None  # (loss, grads) pending backward()
         self._grad_acc = None  # banked grads between backward() and step()
         self._acc_count = 0
-        self._last_grad_norm = 0.0
+        self._pending_metrics = None
 
         self._loss_scaler = create_loss_scaler(
             config.precision,
@@ -291,7 +290,14 @@ class Engine:
         return [self._current_lr()]
 
     def get_global_grad_norm(self):
-        return self._last_grad_norm
+        if self._pending_metrics is None:
+            return 0.0
+        return float(jax.device_get(self._pending_metrics["grad_norm"]))
+
+    @property
+    def skipped_steps(self):
+        """Overflow-skipped optimizer steps (device counter, fetched lazily)."""
+        return int(jax.device_get(self.state.skipped))
 
     def loss_scale(self):
         return float(jax.device_get(self.state.scaler.loss_scale))
@@ -405,6 +411,14 @@ class Engine:
             def fn(state, batch, lr, rng):
                 scale = state.scaler.loss_scale
 
+                if gas == 1:
+                    # no accumulator round-trip on the hot path
+                    loss, grads = self._micro_grads(state.params, batch, rng, scale)
+                    grads = partition.constrain(grads, self.grad_specs, self.mesh)
+                    new_state, metrics = self._apply_update_body(state, grads, lr, 1)
+                    metrics["loss"] = loss
+                    return new_state, metrics
+
                 def resh(x):
                     return jnp.reshape(x, (gas, x.shape[0] // gas) + x.shape[1:])
 
@@ -516,39 +530,46 @@ class Engine:
         else:
             self._grad_acc = jax.tree.map(jnp.add, self._grad_acc, grads)
         self._acc_count += 1
-        self.micro_steps += 1
         return loss
 
     def step(self):
         """Apply the optimizer at the grad-accumulation boundary (reference
-        engine.py:1201)."""
+        engine.py:1201; micro_steps increments here like engine.py:1286, so
+        is_gradient_accumulation_boundary() reads True after the last
+        microbatch's backward())."""
         gas = self.gradient_accumulation_steps()
-        if self._acc_count < gas:
-            return
-        lr = jnp.float32(self._current_lr())
-        # the imperative path banked unscaled-by-gas grads; scale handled in fn
-        new_state, metrics = self._apply_update_fn()(
-            self.state, self._grad_acc, lr, jnp.float32(self._acc_count)
-        )
-        self.state = new_state
-        self._grad_acc = None
-        self._acc_count = 0
-        self._after_optimizer_step(metrics)
+        if self._acc_count >= gas:
+            lr = jnp.float32(self._current_lr())
+            # the imperative path banked unscaled-by-gas grads; scale in fn
+            new_state, metrics = self._apply_update_fn()(
+                self.state, self._grad_acc, lr, jnp.float32(self._acc_count)
+            )
+            self.state = new_state
+            self._grad_acc = None
+            self._acc_count = 0
+            self._after_optimizer_step(metrics)
+        self.micro_steps += 1
 
     def _after_optimizer_step(self, metrics):
-        overflow = bool(jax.device_get(metrics["overflow"]))
-        self._last_grad_norm = float(jax.device_get(metrics["grad_norm"]))
-        if overflow:
-            self.skipped_steps += 1
-            log_dist(
-                f"OVERFLOW! skipping step; loss scale -> {self.loss_scale()}",
-                ranks=[0],
-            )
+        """Bookkeeping after the jitted update. The blocking scalar fetch of
+        the overflow flag only happens for a DYNAMIC loss scaler (fp16), where
+        the host must know whether to step the lr scheduler; the bf16/fp32 hot
+        path stays fully async (overflow still discards the update on device)."""
+        self.global_steps += 1
+        self.global_samples += self.train_batch_size()
+        self._pending_metrics = metrics
+        if self._loss_scaler.dynamic:
+            overflow = bool(jax.device_get(metrics["overflow"]))
+            if overflow:
+                log_dist(
+                    f"OVERFLOW! skipping step; loss scale -> {self.loss_scale()}",
+                    ranks=[0],
+                )
+            elif self.lr_scheduler is not None:
+                self.lr_scheduler.step()
         else:
             if self.lr_scheduler is not None:
                 self.lr_scheduler.step()
-        self.global_steps += 1
-        self.global_samples += self.train_batch_size()
 
     def train_batch(self, batch=None, data_iter=None):
         """Fused one-step API (the TPU-native hot path). Accepts either a full
@@ -586,6 +607,11 @@ class Engine:
     # checkpointing (reference engine.py:1462-1817)
     # ------------------------------------------------------------------ #
 
+    def _fully_replicate(self, tree):
+        """All-gather a sharded pytree so each process holds a full copy."""
+        reps = jax.tree.map(lambda _: NamedSharding(self.mesh, P()), tree)
+        return jax.jit(lambda t: t, out_shardings=reps)(tree)
+
     def save_checkpoint(self, save_dir, tag=None, client_state=None, save_latest=True):
         if tag is None:
             tag = f"global_step{self.global_steps}"
@@ -595,11 +621,16 @@ class Engine:
                 tag, self._config.checkpoint_tag_validation_fail
             )
         ck = CheckpointEngine(save_dir, tag)
-        if jax.process_count() > 1 and jax.process_index() != 0:
-            # single-writer layout: process 0 gathers and writes (per-shard
-            # multi-host save is the orbax-backed path, not yet wired)
-            return True
         state = self.state
+        if jax.process_count() > 1:
+            # single-writer layout: replicate device state so every process
+            # holds an addressable full copy (a jitted identity with
+            # replicated out_shardings = global all-gather), then only
+            # process 0 writes. Per-shard parallel save is the orbax-backed
+            # path, not yet wired.
+            state = self._fully_replicate(state)
+            if jax.process_index() != 0:
+                return True
         model_states = {
             "module": to_host(state.params),
             "global_steps": self.global_steps,
@@ -691,10 +722,12 @@ class Engine:
                 step=jnp.asarray(optim_states["step"], jnp.int32),
             )
 
+        state = state._replace(
+            skipped=jnp.asarray(model_states.get("skipped_steps", 0), jnp.int32)
+        )
         self.state = state
         self.global_steps = int(model_states.get("global_steps", 0))
         self.global_samples = int(model_states.get("global_samples", 0))
-        self.skipped_steps = int(model_states.get("skipped_steps", 0))
         self.micro_steps = int(model_states.get("micro_steps", 0))
         if (
             load_lr_scheduler_states

@@ -37,7 +37,8 @@ class LoggerFactory:
 
 
 logger = LoggerFactory.create_logger(
-    name="DeeperSpeedTPU", level=log_levels.get(os.environ.get("DS_LOG_LEVEL", "info"))
+    name="DeeperSpeedTPU",
+    level=log_levels.get(os.environ.get("DS_LOG_LEVEL", "info").lower(), logging.INFO),
 )
 
 

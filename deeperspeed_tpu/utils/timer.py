@@ -137,14 +137,18 @@ class ThroughputTimer:
         if global_step:
             self.global_step_count += 1
         if self.start_time > 0:
-            _device_sync(sync_with)
+            will_report = global_step and report_speed and (
+                self.global_step_count % self.steps_per_output == 0
+            )
+            # only pay the device sync when this step actually reports —
+            # per-step syncing would stall the async dispatch pipeline
+            if will_report:
+                _device_sync(sync_with)
             self.end_time = time.time()
             duration = self.end_time - self.start_time
             self.total_elapsed_time += duration
             self.step_elapsed_time += duration
-            if global_step and report_speed and (
-                self.global_step_count % self.steps_per_output == 0
-            ):
+            if will_report:
                 self.logging(
                     "epoch={}/micro_step={}/global_step={}, "
                     "RunningAvgSamplesPerSec={:.6g}, CurrSamplesPerSec={:.6g}".format(
