@@ -839,6 +839,28 @@ def initialize(
     if config is None and args is not None:
         config = getattr(args, "deepspeed_config", None)
     assert config is not None, "a config (dict or json path) is required"
+
+    from .pipe.module import PipelineModule
+
+    if isinstance(model, PipelineModule):
+        # reference __init__.py:52 builds a PipelineEngine for PipelineModule
+        from .pipe.engine import PipelineEngine
+
+        world_size = _world_size_for_config(mesh)
+        ds_config = config if isinstance(config, TrainingConfig) else TrainingConfig(
+            config, world_size=world_size
+        )
+        engine = PipelineEngine(
+            module=model,
+            config=ds_config,
+            mesh=mesh,
+            optimizer=optimizer,
+            lr_scheduler=lr_scheduler,
+            training_data=training_data,
+            rng=rng,
+        )
+        return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
+
     assert model_parameters is not None, "model_parameters (params pytree) required"
 
     world_size = _world_size_for_config(mesh)

@@ -1,0 +1,64 @@
+"""Pipeline parallelism (reference deepspeed/runtime/pipe/ + deepspeed/pipe/)."""
+
+from .module import (
+    Embedding,
+    FnLayer,
+    Layer,
+    LayerSpec,
+    Linear,
+    PipelineModule,
+    TiedLayerSpec,
+)
+from .schedule import (
+    BackwardPass,
+    DataParallelSchedule,
+    ForwardPass,
+    InferenceSchedule,
+    LoadMicroBatch,
+    OptimizerStep,
+    PipeInstruction,
+    PipeSchedule,
+    RecvActivation,
+    RecvGrad,
+    ReduceGrads,
+    ReduceTiedGrads,
+    SendActivation,
+    SendGrad,
+    TrainSchedule,
+)
+
+__all__ = [
+    "Layer",
+    "FnLayer",
+    "Linear",
+    "Embedding",
+    "LayerSpec",
+    "TiedLayerSpec",
+    "PipelineModule",
+    "PipeSchedule",
+    "TrainSchedule",
+    "InferenceSchedule",
+    "DataParallelSchedule",
+    "PipeInstruction",
+    "OptimizerStep",
+    "ReduceGrads",
+    "ReduceTiedGrads",
+    "LoadMicroBatch",
+    "ForwardPass",
+    "BackwardPass",
+    "SendActivation",
+    "RecvActivation",
+    "SendGrad",
+    "RecvGrad",
+    "PipelineEngine",
+]
+
+
+def __getattr__(name):
+    # PipelineEngine imports runtime.engine which imports siblings of this
+    # package; lazy import avoids the cycle at import time.
+    if name == "PipelineEngine":
+        from .engine import PipelineEngine
+
+        return PipelineEngine
+    raise AttributeError(name)

@@ -1,0 +1,736 @@
+"""Pipeline execution engine.
+
+Capability parity with /root/reference/deepspeed/runtime/pipe/engine.py:
+`PipelineEngine` (:52) — `train_batch` (:264), `eval_batch` (:351),
+`inference_batch` (fork extra :422), `_exec_schedule` (:1295) with the
+instruction map (:1282), tied-weight gradient reduction (:214) and the
+activation/grad exchange (:939-1105).
+
+TPU-native design. The reference runs one process per stage and moves
+tensors with NCCL broadcast pairs (p2p.py). Here ONE process drives all
+stages: each stage owns a sub-mesh (a slice of the global device mesh along
+the 'pipe' axis), its forward/backward are separately jitted XLA programs,
+and a send/recv is a `jax.device_put` between sub-meshes, sequenced by the
+same instruction schedules. JAX's async dispatch overlaps stage programs
+exactly where the 1F1B schedule allows. Backward recomputes the stage
+forward (full-stage rematerialisation) instead of storing autograd graphs —
+the natural functional formulation of the reference's activation
+checkpointing default.
+
+For maximum single-program performance the fully-jitted SPMD pipeline in
+pipe/spmd.py compiles the whole 1F1B dataflow (ppermute rotation) into one
+XLA program; this engine is the schedule-faithful, API-complete path.
+"""
+
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...checkpoint.serialization import (
+    CheckpointEngine,
+    read_latest,
+    to_host,
+    write_latest,
+)
+from ...parallel.topology import DATA_AXIS, MODEL_AXIS, PIPE_AXIS
+from ...utils.logging import log_dist, logger
+from ...utils.timer import ThroughputTimer
+from .. import lr_schedules
+from .. import utils as runtime_utils
+from ..config import TrainingConfig
+from ..dataloader import RepeatingLoader
+from . import schedule as sched_mod
+from .module import PipelineModule
+
+
+def _stage_meshes(mesh: Optional[Mesh], num_stages: int) -> List[Mesh]:
+    """Slice the global mesh along 'pipe' into one sub-mesh per stage."""
+    if mesh is not None and PIPE_AXIS in mesh.axis_names:
+        axis = mesh.axis_names.index(PIPE_AXIS)
+        assert mesh.devices.shape[axis] == num_stages, (
+            f"mesh pipe axis {mesh.devices.shape[axis]} != stages {num_stages}"
+        )
+        rest_names = tuple(n for n in mesh.axis_names if n != PIPE_AXIS)
+        out = []
+        for s in range(num_stages):
+            devs = np.take(mesh.devices, s, axis=axis)
+            if devs.ndim == 0:
+                devs = devs.reshape(1)
+                rest = (DATA_AXIS,)
+            else:
+                rest = rest_names
+            out.append(Mesh(devs, rest))
+        return out
+    # No pipe axis: round-robin devices over stages (or share device 0).
+    devices = jax.devices()
+    out = []
+    for s in range(num_stages):
+        d = devices[s % len(devices)]
+        out.append(Mesh(np.array([d]), (DATA_AXIS,)))
+    return out
+
+
+def _batch_spec(x) -> P:
+    return P(DATA_AXIS, *([None] * (np.ndim(x) - 1)))
+
+
+class PipelineEngine:
+    """Executes PipeSchedules over a PipelineModule (reference :52)."""
+
+    def __init__(
+        self,
+        module: PipelineModule,
+        config: TrainingConfig,
+        mesh: Optional[Mesh] = None,
+        optimizer=None,
+        lr_scheduler=None,
+        training_data=None,
+        rng=None,
+    ):
+        assert isinstance(module, PipelineModule)
+        self.module = module
+        self._config = config
+        self.num_stages = module.num_stages
+        self.micro_batches = config.gradient_accumulation_steps
+        self.global_mesh = mesh
+        self.stage_meshes = _stage_meshes(mesh, self.num_stages)
+        self.dp_world_size = int(self.stage_meshes[0].shape.get(DATA_AXIS, 1))
+        self._compute_dtype = {
+            "fp16": jnp.float16,
+            "bfloat16": jnp.bfloat16,
+            "fp32": jnp.float32,
+        }[config.precision]
+        ls = float(config.loss_scale or 0.0)
+        if config.precision == "fp16" and ls == 0.0:
+            # static stand-in for the reference's dynamic scaler (pipeline +
+            # dynamic scaling lands with the SPMD pipeline path)
+            ls = 65536.0
+        self.loss_scale_value = ls or 1.0
+
+        # ZeRO >1 cannot compose with PP (reference pipe/engine.py:63).
+        if config.zero_optimization_stage > 1:
+            raise AssertionError(
+                "ZeRO stages 2/3 are incompatible with pipeline parallelism; "
+                "use stage 0/1"
+            )
+
+        from ..engine import Engine, _optimizer_base_lr  # reuse factory
+
+        self.optimizer = optimizer or Engine._configure_basic_optimizer(self)
+        self.lr_scheduler = lr_scheduler
+        if self.lr_scheduler is None and config.scheduler_name:
+            self.lr_scheduler = lr_schedules.get_scheduler(
+                config.scheduler_name, config.scheduler_params or {}
+            )
+        self._client_lr = _optimizer_base_lr(self.optimizer, config)
+
+        self.rng = rng if rng is not None else jax.random.PRNGKey(0)
+        self.global_steps = 0
+        self.global_samples = 0
+        self.micro_steps = 0
+        self.skipped_steps = 0
+
+        self._init_stage_state()
+        self._jit_cache: Dict[Any, Callable] = {}
+        self._compute_loss = True
+        self._reset_buffers(2)
+
+        self.training_dataloader = None
+        self._train_iter = None
+        if training_data is not None:
+            from ..dataloader import DeepSpeedDataLoader
+
+            self.set_dataloader(
+                DeepSpeedDataLoader(
+                    training_data,
+                    batch_size=config.train_micro_batch_size_per_gpu
+                    * self.dp_world_size,
+                )
+            )
+        self.tput_timer = ThroughputTimer(
+            batch_size=config.train_batch_size,
+            num_workers=1,
+            steps_per_output=config.steps_per_print,
+        )
+        log_dist(
+            f"pipeline engine: stages={self.num_stages} micro_batches="
+            f"{self.micro_batches} dp={self.dp_world_size}",
+            ranks=[0],
+        )
+
+    # -------------------------------------------------------------- #
+    # state
+    # -------------------------------------------------------------- #
+
+    def _init_stage_state(self):
+        params_all = self.module.init_params(self.rng)
+        self.stage_params: List[Any] = []
+        self.stage_opt: List[Any] = []
+        self.stage_grads: List[Any] = [None] * self.num_stages
+        for s in range(self.num_stages):
+            sp = self._stage_slice(params_all, s)
+            sp = self._place_stage(sp, s)
+            self.stage_params.append(sp)
+            self.stage_opt.append(
+                jax.jit(self.optimizer.init)(sp)
+            )
+
+    def _stage_slice(self, params_all, stage_id: int):
+        """Extract stage-local params: owned layer slots + tied copies for
+        keys this stage uses; everything else None."""
+        own = set(self.module.stage_layer_indices(stage_id))
+        layers = [
+            p if i in own else None
+            for i, p in enumerate(params_all["layers"])
+        ]
+        tied = {
+            key: params_all["tied"][key]
+            for key in self.module.tied_specs
+            if stage_id in self.module.tied_stages(key)
+        }
+        return {"layers": layers, "tied": tied}
+
+    def _place_stage(self, tree, stage_id: int):
+        m = self.stage_meshes[stage_id]
+        return jax.tree.map(
+            lambda x: jax.device_put(x, NamedSharding(m, P())), tree
+        )
+
+    def _reset_buffers(self, num_buffers: int):
+        n = num_buffers
+        # per-stage buffer pools (each reference rank owns its own buffers)
+        self.buffers = [
+            {
+                "inputs": [None] * n,  # received / loaded activations
+                "labels": [None] * n,  # last stage only
+                "outputs": [None] * n,  # stage forward outputs
+                "in_grads": [None] * n,  # received output-gradients
+                "out_grads": [None] * n,  # produced input-gradients (to send)
+            }
+            for _ in range(self.num_stages)
+        ]
+        # FIFO mailboxes per receiving stage: buffer ids are stage-local in
+        # the schedules, so sends pair with recvs by order on each pipe edge.
+        from collections import deque
+
+        self._act_mail: List[Any] = [deque() for _ in range(self.num_stages)]
+        self._grad_mail: List[Any] = [deque() for _ in range(self.num_stages)]
+        self._losses: List[Any] = []
+
+    # -------------------------------------------------------------- #
+    # jitted stage programs
+    # -------------------------------------------------------------- #
+
+    def _stage_fn(self, stage_id: int, with_loss: bool):
+        """Build (fwd, bwd) jitted programs for one stage. ``with_loss``
+        selects the last-stage variant that applies the module loss_fn.
+        Backward recomputes the forward (full-stage remat); stage 0 never
+        differentiates w.r.t. its (integer token) inputs."""
+        fwd_raw = self.module.stage_forward(stage_id)
+        dtype = self._compute_dtype
+        wrt_input = stage_id > 0
+        loss_fn = self.module.loss_fn
+
+        def cast_params(p):
+            return jax.tree.map(
+                lambda a: a.astype(dtype)
+                if jnp.issubdtype(a.dtype, jnp.floating)
+                else a,
+                p,
+            )
+
+        if with_loss:
+            # Static loss scaling for fp16 (reference runs the pipeline with
+            # an FP16_Optimizer loss scaler); the scaled gradient flows
+            # upstream through SendGrad and every stage unscales at the
+            # accumulation point in _exec_backward_pass.
+            scale = jnp.float32(self.loss_scale_value)
+
+            def f_loss(p, x, label):
+                y = fwd_raw(cast_params(p), x)
+                loss = loss_fn(y, label).astype(jnp.float32)
+                return loss * scale, loss
+
+            argnums = (0, 1) if wrt_input else (0,)
+
+            def fwd(p, x, label):
+                _, loss = f_loss(p, x, label)
+                return loss
+
+            def bwd(p, x, label):
+                grads, loss = jax.grad(f_loss, argnums=argnums, has_aux=True)(
+                    p, x, label
+                )
+                dp = grads[0]
+                dx = grads[1] if wrt_input else None
+                dp = jax.tree.map(lambda a: a.astype(jnp.float32), dp)
+                return loss, dp, dx
+
+            return jax.jit(fwd), jax.jit(bwd)
+
+        def f(p, x):
+            return fwd_raw(cast_params(p), x)
+
+        def bwd(p, x, g):
+            if wrt_input:
+                _, vjp = jax.vjp(f, p, x)
+                dp, dx = vjp(g)
+            else:
+                _, vjp = jax.vjp(lambda p_: f(p_, x), p)
+                (dp,) = vjp(g)
+                dx = None
+            dp = jax.tree.map(lambda a: a.astype(jnp.float32), dp)
+            return dp, dx
+
+        return jax.jit(f), jax.jit(bwd)
+
+    def _get_stage_fns(self, stage_id: int, with_loss: bool):
+        key = (stage_id, with_loss)
+        if key not in self._jit_cache:
+            self._jit_cache[key] = self._stage_fn(stage_id, with_loss)
+        return self._jit_cache[key]
+
+    # -------------------------------------------------------------- #
+    # instruction executors (reference _INSTRUCTION_MAP :1282)
+    # -------------------------------------------------------------- #
+
+    def _place_batch_on_stage(self, tree, stage_id: int):
+        m = self.stage_meshes[stage_id]
+        return jax.tree.map(
+            lambda x: jax.device_put(
+                np.asarray(x), NamedSharding(m, _batch_spec(x))
+            ),
+            tree,
+        )
+
+    def _exec_load_micro_batch(self, stage_id, buffer_id, train=True):
+        """Each loading stage consumes micro-batches in order from its own
+        counter (the reference gives every stage its own data iterator)."""
+        inputs, labels = self._micro_batch(self._mb_count[stage_id])
+        self._mb_count[stage_id] += 1
+        if stage_id == 0:
+            self.buffers[stage_id]["inputs"][buffer_id] = self._place_batch_on_stage(
+                inputs, stage_id
+            )
+        if stage_id == self.num_stages - 1 and labels is not None:
+            self.buffers[stage_id]["labels"][buffer_id] = self._place_batch_on_stage(
+                labels, stage_id
+            )
+
+    def _exec_forward_pass(self, stage_id, buffer_id, train=True):
+        is_last = stage_id == self.num_stages - 1
+        with_loss = (
+            is_last
+            and self._compute_loss
+            and self.module.loss_fn is not None
+            and self.buffers[stage_id]["labels"][buffer_id] is not None
+        )
+        fwd, _ = self._get_stage_fns(stage_id, with_loss)
+        x = self.buffers[stage_id]["inputs"][buffer_id]
+        if with_loss:
+            loss = fwd(
+                self.stage_params[stage_id], x, self.buffers[stage_id]["labels"][buffer_id]
+            )
+            self._losses.append(loss)
+        else:
+            y = fwd(self.stage_params[stage_id], x)
+            self.buffers[stage_id]["outputs"][buffer_id] = y
+            if is_last:
+                self._outputs_final.append(y)
+
+    def _exec_backward_pass(self, stage_id, buffer_id):
+        is_last = stage_id == self.num_stages - 1
+        with_loss = is_last and self.module.loss_fn is not None
+        _, bwd = self._get_stage_fns(stage_id, with_loss)
+        x = self.buffers[stage_id]["inputs"][buffer_id]
+        if with_loss:
+            loss, dp, dx = bwd(
+                self.stage_params[stage_id], x, self.buffers[stage_id]["labels"][buffer_id]
+            )
+        else:
+            g = self.buffers[stage_id]["in_grads"][buffer_id]
+            dp, dx = bwd(self.stage_params[stage_id], x, g)
+        scale = 1.0 / (self.micro_batches * self.loss_scale_value)
+        dp = jax.tree.map(lambda a: a * scale, dp)
+        if self.stage_grads[stage_id] is None:
+            self.stage_grads[stage_id] = dp
+        else:
+            self.stage_grads[stage_id] = jax.tree.map(
+                jnp.add, self.stage_grads[stage_id], dp
+            )
+        self.buffers[stage_id]["out_grads"][buffer_id] = dx
+
+    def _exec_send_activation(self, stage_id, buffer_id):
+        y = self.buffers[stage_id]["outputs"][buffer_id]
+        target = stage_id + 1
+        y = jax.tree.map(
+            lambda a: jax.device_put(
+                a, NamedSharding(self.stage_meshes[target], _batch_spec(a))
+            ),
+            y,
+        )
+        self._act_mail[target].append(y)
+
+    def _exec_recv_activation(self, stage_id, buffer_id):
+        self.buffers[stage_id]["inputs"][buffer_id] = self._act_mail[stage_id].popleft()
+
+    def _exec_send_grad(self, stage_id, buffer_id):
+        g = self.buffers[stage_id]["out_grads"][buffer_id]
+        target = stage_id - 1
+        g = jax.tree.map(
+            lambda a: jax.device_put(
+                a, NamedSharding(self.stage_meshes[target], _batch_spec(a))
+            ),
+            g,
+        )
+        self._grad_mail[target].append(g)
+
+    def _exec_recv_grad(self, stage_id, buffer_id):
+        self.buffers[stage_id]["in_grads"][buffer_id] = self._grad_mail[stage_id].popleft()
+
+    def _exec_reduce_tied_grads(self):
+        """Sum tied-weight grads across the stages sharing them (reference
+        allreduce_tied_weight_gradients, module.py:415) and hand every
+        sharing stage the total, so their identical optimizer updates keep
+        the copies in lockstep."""
+        for key in self.module.tied_specs:
+            stages = self.module.tied_stages(key)
+            if len(stages) < 2:
+                continue
+            owner = stages[0]
+            total = None
+            for s in stages:
+                g = self.stage_grads[s]["tied"].get(key) if self.stage_grads[s] else None
+                if g is None:
+                    continue
+                g_local = jax.tree.map(
+                    lambda a: jax.device_put(
+                        a, NamedSharding(self.stage_meshes[owner], P())
+                    ),
+                    g,
+                )
+                total = g_local if total is None else jax.tree.map(
+                    jnp.add, total, g_local
+                )
+            if total is None:
+                continue
+            for s in stages:
+                placed = jax.tree.map(
+                    lambda a: jax.device_put(
+                        a, NamedSharding(self.stage_meshes[s], P())
+                    ),
+                    total,
+                )
+                self.stage_grads[s]["tied"][key] = placed
+
+    def _exec_reduce_grads(self):
+        """Data-parallel gradient reduction. The stage programs run under
+        GSPMD on the stage sub-mesh with replicated params and data-sharded
+        batches, so XLA already psums parameter grads across the 'data'
+        axis — this instruction is the schedule-visible marker."""
+
+    def _stage_norm_view(self, g, stage_id: int):
+        """The stage's grads with tied duplicates dropped: after
+        ReduceTiedGrads every sharing stage holds the SAME summed tied grad,
+        so only the owner stage's copy may enter the global norm."""
+        tied = {
+            key: val
+            for key, val in g["tied"].items()
+            if self.module.tied_owner_stage(key) == stage_id
+        }
+        return {"layers": g["layers"], "tied": tied}
+
+    def _exec_optimizer_step(self):
+        clip = float(self._config.gradient_clipping or 0.0)
+        if "sqnorm" not in self._jit_cache:
+            self._jit_cache["sqnorm"] = jax.jit(runtime_utils.global_sqnorm)
+        sq = 0.0
+        for s in range(self.num_stages):
+            g = self.stage_grads[s]
+            if g is None:
+                continue
+            sq += float(
+                jax.device_get(self._jit_cache["sqnorm"](self._stage_norm_view(g, s)))
+            )
+        gnorm = float(np.sqrt(sq))
+        if not np.isfinite(gnorm):
+            # overflow skip-step (reference engine.py:1184-1192)
+            self.skipped_steps += 1
+            self.stage_grads = [None] * self.num_stages
+            self._last_grad_norm = gnorm
+            log_dist(f"non-finite grad norm {gnorm}; skipping step", ranks=[0])
+            return
+        coef = 1.0 if clip <= 0 else min(1.0, clip / (gnorm + 1e-6))
+        lr = jnp.float32(self._current_lr())
+
+        for s in range(self.num_stages):
+            g = self.stage_grads[s]
+            if g is None:
+                continue
+            key = ("opt", s)
+            if key not in self._jit_cache:
+                opt = self.optimizer
+
+                def upd(params, opt_state, grads, lr, coef):
+                    grads = jax.tree.map(lambda a: a * coef, grads)
+                    return opt.update(grads, opt_state, params, lr)
+
+                self._jit_cache[key] = jax.jit(upd, donate_argnums=(0, 1))
+            self.stage_params[s], self.stage_opt[s] = self._jit_cache[key](
+                self.stage_params[s],
+                self.stage_opt[s],
+                g,
+                lr,
+                jnp.float32(coef),
+            )
+            self.stage_grads[s] = None
+        self._last_grad_norm = gnorm
+        self.global_steps += 1
+        self.global_samples += self._config.train_batch_size
+        if self.lr_scheduler is not None:
+            self.lr_scheduler.step()
+
+    def _current_lr(self):
+        if self.lr_scheduler is not None:
+            return float(self.lr_scheduler.get_lr())
+        return float(self._client_lr)
+
+    def get_lr(self):
+        return [self._current_lr()]
+
+    def get_global_grad_norm(self):
+        return getattr(self, "_last_grad_norm", 0.0)
+
+    # -------------------------------------------------------------- #
+    # schedule execution (reference _exec_schedule :1295)
+    # -------------------------------------------------------------- #
+
+    _SEND_TYPES = (sched_mod.SendActivation, sched_mod.SendGrad)
+
+    def _exec_schedule(self, make_schedule, train: bool, compute_loss: bool = True):
+        schedules = [
+            make_schedule(self.micro_batches, self.num_stages, s)
+            for s in range(self.num_stages)
+        ]
+        nbuf = max(s.num_pipe_buffers() for s in schedules)
+        self._reset_buffers(nbuf)
+        self._outputs_final: List[Any] = []
+        self._compute_loss = compute_loss
+        self._mb_count = [0] * self.num_stages
+        streams = [list(s.steps()) for s in schedules]
+        total_steps = max(len(st) for st in streams)
+        for t in range(total_steps):
+            step_cmds = [
+                streams[s][t] if t < len(streams[s]) else [] for s in
+                range(self.num_stages)
+            ]
+            # Phase 1: sends (reference only data produced at steps < t).
+            for s in range(self.num_stages):
+                for cmd in step_cmds[s]:
+                    if isinstance(cmd, sched_mod.SendActivation):
+                        self._exec_send_activation(s, cmd.buffer_id)
+                    elif isinstance(cmd, sched_mod.SendGrad):
+                        self._exec_send_grad(s, cmd.buffer_id)
+            # Phase 2: everything else, stage order.
+            did_global = False
+            for s in range(self.num_stages):
+                for cmd in step_cmds[s]:
+                    if isinstance(cmd, self._SEND_TYPES):
+                        continue
+                    if isinstance(cmd, sched_mod.RecvActivation):
+                        self._exec_recv_activation(s, cmd.buffer_id)
+                    elif isinstance(cmd, sched_mod.RecvGrad):
+                        self._exec_recv_grad(s, cmd.buffer_id)
+                    elif isinstance(cmd, sched_mod.LoadMicroBatch):
+                        self._exec_load_micro_batch(s, cmd.buffer_id, train)
+                    elif isinstance(cmd, sched_mod.ForwardPass):
+                        self._exec_forward_pass(s, cmd.buffer_id, train)
+                    elif isinstance(cmd, sched_mod.BackwardPass):
+                        self._exec_backward_pass(s, cmd.buffer_id)
+                    elif isinstance(cmd, sched_mod.ReduceTiedGrads):
+                        if not did_global:
+                            self._exec_reduce_tied_grads()
+                    elif isinstance(cmd, sched_mod.ReduceGrads):
+                        if not did_global:
+                            self._exec_reduce_grads()
+                    elif isinstance(cmd, sched_mod.OptimizerStep):
+                        if not did_global:
+                            self._exec_optimizer_step()
+                            did_global = True
+                    else:
+                        raise RuntimeError(f"unknown instruction {cmd!r}")
+
+    # -------------------------------------------------------------- #
+    # data plumbing
+    # -------------------------------------------------------------- #
+
+    def _micro_batch(self, index: int):
+        """Fetch micro-batch ``index`` of the current global batch as an
+        (inputs, labels) pair."""
+        mb = self._current_micro_batches[index]
+        if isinstance(mb, (tuple, list)) and len(mb) == 2:
+            return mb[0], mb[1]
+        return mb, None
+
+    def _pull_micro_batches(self, data_iter):
+        self._current_micro_batches = [
+            next(data_iter) for _ in range(self.micro_batches)
+        ]
+
+    def set_dataloader(self, loader):
+        self.training_dataloader = loader
+        self._train_iter = iter(RepeatingLoader(loader))
+
+    # -------------------------------------------------------------- #
+    # public API (reference train_batch :264, eval_batch :351,
+    # inference_batch :422)
+    # -------------------------------------------------------------- #
+
+    def train_batch(self, data_iter=None):
+        if data_iter is None:
+            assert self._train_iter is not None, "no data iterator"
+            data_iter = self._train_iter
+        self.tput_timer.start()
+        self._pull_micro_batches(data_iter)
+        self._exec_schedule(sched_mod.TrainSchedule, train=True)
+        self.micro_steps += self.micro_batches
+        loss = self._aggregate_total_loss()
+        self.tput_timer.stop(global_step=True, sync_with=None)
+        if self.global_steps % self._config.steps_per_print == 0:
+            log_dist(
+                f"step={self.global_steps} loss={float(loss):.4f} "
+                f"lr={self._current_lr():.3e}",
+                ranks=[0],
+            )
+        return loss
+
+    def eval_batch(self, data_iter):
+        """Forward-only pipelined evaluation returning the mean loss
+        (reference eval_batch :351)."""
+        self._pull_micro_batches(data_iter)
+        self._exec_schedule(sched_mod.InferenceSchedule, train=False)
+        return self._aggregate_total_loss()
+
+    def inference_batch(self, inputs):
+        """Forward-only pipelined inference returning the last stage's output
+        (fork extra, reference pipe/engine.py:422)."""
+        self._current_micro_batches = [(inputs, None)]
+        saved = self.micro_batches
+        self.micro_batches = 1
+        try:
+            self._exec_schedule(
+                sched_mod.InferenceSchedule, train=False, compute_loss=False
+            )
+        finally:
+            self.micro_batches = saved
+        return self._outputs_final[-1]
+
+    def _aggregate_total_loss(self):
+        """DP-mean already taken inside each jitted loss; average over
+        micro-batches (reference _aggregate_total_loss :559)."""
+        if not self._losses:
+            return jnp.float32(0.0)
+        return sum(float(jax.device_get(l)) for l in self._losses) / len(self._losses)
+
+    # -------------------------------------------------------------- #
+    # config accessors mirroring Engine
+    # -------------------------------------------------------------- #
+
+    def train_batch_size(self):
+        return self._config.train_batch_size
+
+    def gradient_accumulation_steps(self):
+        return self._config.gradient_accumulation_steps
+
+    def train_micro_batch_size_per_gpu(self):
+        return self._config.train_micro_batch_size_per_gpu
+
+    def is_gradient_accumulation_boundary(self):
+        return True
+
+    # -------------------------------------------------------------- #
+    # checkpoint (reference pipe layer files + engine state)
+    # -------------------------------------------------------------- #
+
+    def _gather_params_all(self):
+        """Merge per-stage param slices back into one params dict."""
+        layers = [None] * self.module.num_layers()
+        tied: Dict[str, Any] = {}
+        for s in range(self.num_stages):
+            sp = jax.device_get(to_host(self.stage_params[s]))
+            for i in self.module.stage_layer_indices(s):
+                if sp["layers"][i] is not None:
+                    layers[i] = sp["layers"][i]
+            for key, val in sp["tied"].items():
+                tied.setdefault(key, val)
+        return {"layers": layers, "tied": tied}
+
+    def save_checkpoint(self, save_dir, tag=None, client_state=None, save_latest=True):
+        if tag is None:
+            tag = f"global_step{self.global_steps}"
+        ck = CheckpointEngine(save_dir, str(tag))
+        params_all = self._gather_params_all()
+        self.module.save_state_dict(ck.ckpt_dir, params_all)
+        meta = {
+            "global_steps": self.global_steps,
+            "global_samples": self.global_samples,
+            "micro_steps": self.micro_steps,
+            "num_stages": self.num_stages,
+            "parts": list(self.module.parts),
+            "lr_scheduler": self.lr_scheduler.state_dict() if self.lr_scheduler else {},
+            "client_state": client_state or {},
+            "opt_states": [to_host(o) for o in self.stage_opt],
+        }
+        ck.save("pipeline_engine_states.msgpack", meta)
+        if save_latest:
+            write_latest(save_dir, str(tag))
+        log_dist(f"saved pipeline checkpoint {ck.ckpt_dir}", ranks=[0])
+        return True
+
+    def load_checkpoint(self, load_dir, tag=None, load_optimizer_states=True,
+                        load_lr_scheduler_states=True):
+        if tag is None:
+            tag = read_latest(load_dir)
+            if tag is None:
+                return None, {}
+        ck = CheckpointEngine(load_dir, str(tag))
+        if not ck.exists("pipeline_engine_states.msgpack"):
+            logger.warning("pipeline checkpoint %s missing", ck.ckpt_dir)
+            return None, {}
+        params_all = self._gather_params_all()
+        params_all = self.module.load_state_dir(ck.ckpt_dir, params_all)
+        for s in range(self.num_stages):
+            sp = self._stage_slice(params_all, s)
+            self.stage_params[s] = self._place_stage(sp, s)
+        meta = ck.load("pipeline_engine_states.msgpack")
+        self.global_steps = int(meta.get("global_steps", 0))
+        self.global_samples = int(meta.get("global_samples", 0))
+        self.micro_steps = int(meta.get("micro_steps", 0))
+        if load_optimizer_states and meta.get("opt_states"):
+            from flax import serialization
+
+            opt_states = meta["opt_states"]
+            for s in range(self.num_stages):
+                # msgpack round-trips lists as {str(i): v} dicts
+                entry = (
+                    opt_states[s]
+                    if isinstance(opt_states, (list, tuple))
+                    else opt_states[str(s)]
+                )
+                restored = serialization.from_state_dict(
+                    jax.device_get(to_host(self.stage_opt[s])), entry
+                )
+                self.stage_opt[s] = jax.tree.map(
+                    lambda ref, v: jax.device_put(
+                        jnp.asarray(v, ref.dtype), ref.sharding
+                    ),
+                    self.stage_opt[s],
+                    restored,
+                )
+        if load_lr_scheduler_states and self.lr_scheduler and meta.get("lr_scheduler"):
+            self.lr_scheduler.load_state_dict(meta["lr_scheduler"])
+        log_dist(f"loaded pipeline checkpoint {ck.ckpt_dir}", ranks=[0])
+        return ck.ckpt_dir, meta.get("client_state", {})

@@ -1,0 +1,373 @@
+"""Pipeline model container.
+
+Capability parity with /root/reference/deepspeed/runtime/pipe/module.py:
+`LayerSpec` (:23), `TiedLayerSpec` (:72), `PipelineModule` (:86) with
+layer partitioning `uniform|parameters|type:regex` (:358, backed by the
+balanced-partition solver in runtime/utils.py), tied-module indexing (:430)
+and per-layer checkpoint files (:536-581).
+
+JAX design: a "layer" is a functional pair ``init(rng) -> params`` /
+``apply(params, x, rng) -> y`` instead of an nn.Module. Plain callables
+(activations, reshapes) are zero-param layers, as in the reference where
+lambdas are allowed in the layer list. The module owns per-layer param
+pytrees; a stage's forward composes its contiguous slice of layers, with
+`jax.checkpoint` applied every ``activation_checkpoint_interval`` layers
+(the analog of reference module.py:~330 checkpointed exec ranges).
+"""
+
+import re
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...utils.logging import logger
+from ..utils import partition_balanced, partition_uniform
+
+
+class Layer:
+    """Functional layer protocol: subclass and implement init/apply."""
+
+    def init(self, rng) -> Any:  # pragma: no cover - interface
+        return None
+
+    def apply(self, params, x, rng=None):  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+class FnLayer(Layer):
+    """Zero-parameter layer wrapping a plain callable."""
+
+    def __init__(self, fn: Callable):
+        self.fn = fn
+        self.__name__ = getattr(fn, "__name__", type(fn).__name__)
+
+    def init(self, rng):
+        return None
+
+    def apply(self, params, x, rng=None):
+        return self.fn(x)
+
+
+class Linear(Layer):
+    """Dense layer for tests/examples (reference tests stack nn.Linear)."""
+
+    def __init__(self, in_dim: int, out_dim: int, bias: bool = True, scale: float = 1.0):
+        self.in_dim, self.out_dim, self.bias, self.scale = in_dim, out_dim, bias, scale
+
+    def init(self, rng):
+        w = jax.random.normal(rng, (self.in_dim, self.out_dim), jnp.float32)
+        w = w * (self.scale / np.sqrt(self.in_dim))
+        p = {"w": w}
+        if self.bias:
+            p["b"] = jnp.zeros((self.out_dim,), jnp.float32)
+        return p
+
+    def apply(self, params, x, rng=None):
+        y = x @ params["w"]
+        if self.bias:
+            y = y + params["b"]
+        return y
+
+
+class Embedding(Layer):
+    def __init__(self, vocab: int, dim: int):
+        self.vocab, self.dim = vocab, dim
+
+    def init(self, rng):
+        return {"w": jax.random.normal(rng, (self.vocab, self.dim), jnp.float32) * 0.02}
+
+    def apply(self, params, x, rng=None):
+        return jnp.take(params["w"], x, axis=0)
+
+
+def _as_layer(obj) -> Layer:
+    if isinstance(obj, Layer):
+        return obj
+    if callable(obj):
+        return FnLayer(obj)
+    raise TypeError(f"not a pipeline layer: {obj!r}")
+
+
+class LayerSpec:
+    """Deferred layer construction (reference LayerSpec :23): stores the
+    class/factory and arguments; `build()` instantiates. Keeping specs
+    instead of instances lets each stage build only the layers it owns."""
+
+    def __init__(self, typename, *module_args, **module_kwargs):
+        self.typename = typename
+        self.module_args = module_args
+        self.module_kwargs = module_kwargs
+        if not callable(typename):
+            raise RuntimeError("LayerSpec requires a callable type/factory")
+        self.name = getattr(typename, "__name__", str(typename))
+
+    def __repr__(self):
+        from ..utils import call_to_str
+
+        return call_to_str(self.name, *self.module_args, **self.module_kwargs)
+
+    def build(self, log: bool = False) -> Layer:
+        if log:
+            logger.info("building %r", self)
+        if isinstance(self.typename, type) or self.module_args or self.module_kwargs:
+            return _as_layer(self.typename(*self.module_args, **self.module_kwargs))
+        # a bare callable with no construction args IS the layer (activation
+        # functions etc. — the reference allows lambdas in the layer list)
+        return _as_layer(self.typename)
+
+
+class TiedLayerSpec(LayerSpec):
+    """A LayerSpec whose parameters are shared with every other spec carrying
+    the same ``key`` (reference :72 — e.g. tied input/output embeddings).
+    ``forward_fn`` optionally reinterprets the shared params (e.g. use the
+    embedding matrix transposed as the LM head)."""
+
+    def __init__(self, key, typename, *module_args, forward_fn=None,
+                 tied_weight_attr="weight", **module_kwargs):
+        super().__init__(typename, *module_args, **module_kwargs)
+        self.key = key
+        self.forward_fn = forward_fn
+        self.tied_weight_attr = tied_weight_attr
+
+
+class PipelineModule:
+    """Partitions a flat layer list into pipeline stages.
+
+    Args:
+        layers: sequence of LayerSpec / Layer / callables.
+        num_stages: pipeline depth (or derive from topology).
+        topology: optional ProcessTopology with a 'pipe' axis.
+        loss_fn: callable (output, label) -> scalar loss, used by the last
+            stage during training.
+        partition_method: 'parameters' | 'uniform' | 'type:<regex>'.
+        activation_checkpoint_interval: remat every N layers (0 = off).
+    """
+
+    def __init__(
+        self,
+        layers: Sequence[Any],
+        num_stages: Optional[int] = None,
+        topology=None,
+        loss_fn: Optional[Callable] = None,
+        seed_layers: bool = False,
+        base_seed: int = 1234,
+        partition_method: str = "parameters",
+        activation_checkpoint_interval: int = 0,
+    ):
+        if num_stages is None and topology is None:
+            raise RuntimeError("must provide num_stages or topology")
+        self._topo = topology
+        if num_stages is None:
+            num_stages = topology.get_dim("pipe")
+        self.num_stages = num_stages
+        self.loss_fn = loss_fn
+        self.seed_layers = seed_layers
+        self.base_seed = base_seed
+        self.activation_checkpoint_interval = activation_checkpoint_interval
+
+        def wrap(s):
+            if isinstance(s, LayerSpec):
+                return s
+            spec = LayerSpec(lambda obj=s: obj)
+            # preserve the wrapped object's type/function name so
+            # `type:<regex>` partitioning sees it (not '<lambda>')
+            spec.name = getattr(s, "__name__", type(s).__name__)
+            return spec
+
+        self._layer_specs = [wrap(s) for s in layers]
+        # keep original objects for non-spec entries so type partitioning and
+        # building work
+        self._orig = list(layers)
+
+        self.parts = self._partition_layers(partition_method)
+        # build every layer once (host-side objects are cheap; params are the
+        # expensive part and are created per-stage in init_params)
+        self._built = [self._build_layer(i) for i in range(len(self._layer_specs))]
+        self.tied_specs: Dict[str, List[int]] = {}
+        for i, spec in enumerate(self._layer_specs):
+            if isinstance(spec, TiedLayerSpec):
+                self.tied_specs.setdefault(spec.key, []).append(i)
+
+    # -------------------------------------------------------------- #
+    # construction
+    # -------------------------------------------------------------- #
+
+    def _build_layer(self, idx: int) -> Layer:
+        orig = self._orig[idx]
+        if isinstance(orig, LayerSpec):
+            return orig.build()
+        return _as_layer(orig)
+
+    def _count_layer_params(self, idx: int) -> int:
+        obj = self._built[idx] if hasattr(self, "_built") else self._build_layer(idx)
+        shapes = jax.eval_shape(obj.init, jax.random.PRNGKey(0))
+        if shapes is None:
+            return 0
+        return sum(int(np.prod(s.shape)) for s in jax.tree.leaves(shapes))
+
+    def _partition_layers(self, method: str) -> List[int]:
+        """Compute stage boundaries (reference _partition_layers :358)."""
+        n = len(self._layer_specs)
+        method = method.lower()
+        if method == "uniform":
+            parts = partition_uniform(n, self.num_stages)
+        elif method == "parameters":
+            weights = [max(1, self._count_layer_params(i)) for i in range(n)]
+            parts = partition_balanced(weights, self.num_stages)
+        elif method.startswith("type:"):
+            pat = method.split(":", 1)[1]
+            weights = [
+                1 if re.search(pat, self._layer_specs[i].name, re.IGNORECASE) else 0
+                for i in range(n)
+            ]
+            if sum(weights) == 0:
+                raise RuntimeError(f"no layers match type regex {pat!r}")
+            parts = partition_balanced(weights, self.num_stages)
+        elif method == "profile":
+            raise NotImplementedError("profile-based partitioning not supported")
+        else:
+            raise NotImplementedError(f"partition method {method!r}")
+        logger.info("pipeline partition (%s): %s", method, parts)
+        return parts
+
+    # -------------------------------------------------------------- #
+    # stage views
+    # -------------------------------------------------------------- #
+
+    def stage_layer_indices(self, stage_id: int) -> range:
+        return range(self.parts[stage_id], self.parts[stage_id + 1])
+
+    def stage_owning_layer(self, layer_idx: int) -> int:
+        for s in range(self.num_stages):
+            if self.parts[s] <= layer_idx < self.parts[s + 1]:
+                return s
+        raise IndexError(layer_idx)
+
+    def tied_owner_stage(self, key: str) -> int:
+        """The lowest stage touching a tie owns the canonical copy."""
+        return min(self.stage_owning_layer(i) for i in self.tied_specs[key])
+
+    def tied_stages(self, key: str) -> List[int]:
+        return sorted({self.stage_owning_layer(i) for i in self.tied_specs[key]})
+
+    def init_params(self, rng) -> Dict[str, Any]:
+        """Create all params: ``{'layers': [per-layer pytree|None],
+        'tied': {key: pytree}}``. Tied layers draw from the first spec in the
+        tie group; their per-layer slot is None."""
+        layer_params: List[Any] = []
+        tied: Dict[str, Any] = {}
+        for i, layer in enumerate(self._built):
+            spec = self._layer_specs[i]
+            if self.seed_layers:
+                lrng = jax.random.PRNGKey(self.base_seed + i)
+            else:
+                rng, lrng = jax.random.split(rng)
+            if isinstance(spec, TiedLayerSpec):
+                if spec.key not in tied:
+                    tied[spec.key] = layer.init(lrng)
+                layer_params.append(None)
+            else:
+                layer_params.append(layer.init(lrng))
+        return {"layers": layer_params, "tied": tied}
+
+    def apply_layer(self, idx: int, params_all, x, rng=None):
+        spec = self._layer_specs[idx]
+        layer = self._built[idx]
+        if isinstance(spec, TiedLayerSpec):
+            p = params_all["tied"][spec.key]
+            if spec.forward_fn is not None:
+                return spec.forward_fn(p, x)
+            return layer.apply(p, x, rng)
+        return layer.apply(params_all["layers"][idx], x, rng)
+
+    def stage_forward(self, stage_id: int) -> Callable:
+        """Composable stage function: (stage_params, x, rng) -> y where
+        ``stage_params`` is the full params dict (only this stage's slots are
+        populated). Applies remat every activation_checkpoint_interval
+        layers."""
+        idxs = list(self.stage_layer_indices(stage_id))
+        interval = self.activation_checkpoint_interval
+
+        def run_range(params_all, x, rng, lo, hi):
+            for j in range(lo, hi):
+                sub = jax.random.fold_in(rng, j) if rng is not None else None
+                x = self.apply_layer(idxs[j], params_all, x, sub)
+            return x
+
+        def fwd(params_all, x, rng=None):
+            n = len(idxs)
+            if interval and interval > 0:
+                j = 0
+                while j < n:
+                    hi = min(j + interval, n)
+
+                    def blk(p, y, lo=j, hi=hi):
+                        return run_range(p, y, rng, lo, hi)
+
+                    x = jax.checkpoint(blk)(params_all, x)
+                    j = hi
+            else:
+                x = run_range(params_all, x, rng, 0, n)
+            return x
+
+        return fwd
+
+    # -------------------------------------------------------------- #
+    # per-layer checkpoint layout (reference :520-581)
+    # -------------------------------------------------------------- #
+
+    @staticmethod
+    def ckpt_layer_path(ckpt_dir: str, local_layer_idx: int, mp_rank: int = 0) -> str:
+        import os
+
+        return os.path.join(
+            ckpt_dir, f"layer_{local_layer_idx:02d}-model_{mp_rank:02d}-model_states.msgpack"
+        )
+
+    def save_state_dict(self, save_dir: str, params_all, mp_rank: int = 0):
+        """Write one file per layer so checkpoints survive pipeline/TP
+        re-grouping (reference save_state_dict :546)."""
+        import os
+
+        from ...checkpoint.serialization import save_tree
+
+        os.makedirs(save_dir, exist_ok=True)
+        for idx in range(len(self._layer_specs)):
+            spec = self._layer_specs[idx]
+            if isinstance(spec, TiedLayerSpec):
+                if self.tied_specs[spec.key][0] != idx:
+                    continue  # only the canonical copy is written
+                p = params_all["tied"][spec.key]
+            else:
+                p = params_all["layers"][idx]
+            if p is None:
+                continue
+            save_tree(self.ckpt_layer_path(save_dir, idx, mp_rank), p)
+
+    def load_state_dir(self, load_dir: str, params_all, mp_rank: int = 0):
+        """Load per-layer files back into a params dict (reference
+        load_state_dir :561). Missing zero-param layers are skipped."""
+        import os
+
+        from ...checkpoint.serialization import load_tree
+
+        layers = list(params_all["layers"])
+        tied = dict(params_all["tied"])
+        for idx in range(len(self._layer_specs)):
+            path = self.ckpt_layer_path(load_dir, idx, mp_rank)
+            if not os.path.exists(path):
+                continue
+            spec = self._layer_specs[idx]
+            if isinstance(spec, TiedLayerSpec):
+                tied[spec.key] = load_tree(path, tied[spec.key])
+            else:
+                layers[idx] = load_tree(path, layers[idx])
+        return {"layers": layers, "tied": tied}
+
+    def topology(self):
+        return self._topo
+
+    def num_layers(self) -> int:
+        return len(self._layer_specs)
