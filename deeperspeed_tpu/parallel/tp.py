@@ -1,0 +1,310 @@
+"""Native tensor (model) parallelism.
+
+The reference framework did NOT implement TP — it consumed an external
+Megatron-style ``mpu`` object (/root/reference/deepspeed/__init__.py:80,
+runtime/engine.py:630-641) that supplied model-parallel rank/group queries,
+while Megatron supplied ColumnParallelLinear / RowParallelLinear /
+VocabParallelEmbedding. A TPU-native rebuild must provide the real thing
+(SURVEY §7 phase 8): here TP is expressed as PartitionSpecs over the
+``'model'`` mesh axis and XLA inserts the collectives — the all-reduce that
+Megatron issues by hand at the end of RowParallelLinear appears automatically
+when the sharded contraction's output is constrained to be replicated.
+
+Two surfaces:
+
+  * Functional/pjit surface — ``column_parallel_spec`` / ``row_parallel_spec``
+    PartitionSpecs plus ``ColumnParallelLinear`` / ``RowParallelLinear`` /
+    ``VocabParallelEmbedding`` Layer classes (pipeline-compatible; see
+    runtime/pipe/module.py Layer protocol) carrying their own specs.
+  * ``ModelParallelUnit`` — the mpu-compatible adapter object GPT-NeoX-style
+    callers pass to ``initialize(mpu=...)``: get_model_parallel_rank/
+    world_size/group etc., answered from a Mesh instead of torch process
+    groups.
+"""
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .topology import DATA_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS
+
+
+# ------------------------------------------------------------------ #
+# PartitionSpec builders (the TP "layout algebra")
+# ------------------------------------------------------------------ #
+
+
+def column_parallel_spec(stacked: bool = False) -> P:
+    """Weight (in, out) split on the OUTPUT dim — Megatron column parallel.
+
+    ``stacked=True`` prepends a layer axis (scan-stacked models)."""
+    return P(None, None, MODEL_AXIS) if stacked else P(None, MODEL_AXIS)
+
+
+def row_parallel_spec(stacked: bool = False) -> P:
+    """Weight (in, out) split on the INPUT dim — Megatron row parallel."""
+    return P(None, MODEL_AXIS, None) if stacked else P(MODEL_AXIS, None)
+
+
+def vocab_parallel_spec() -> P:
+    """Embedding table (vocab, dim) layout for TP.
+
+    NOTE: shards the embedding DIM, not vocab rows. XLA's SPMD partitioner
+    handles a vocab-row-sharded gather by replicating the whole table, so the
+    Megatron row split is an anti-layout on TPU; the column split keeps the
+    gather local (see VocabParallelEmbedding)."""
+    return P(None, MODEL_AXIS)
+
+
+def constrain(x, spec: P, mesh: Optional[Mesh]):
+    """with_sharding_constraint that tolerates meshes lacking some axes.
+
+    Entries may be axis names, None (force replicated on that dim) or
+    ``P.UNCONSTRAINED`` (let the partitioner keep whatever sharding — e.g.
+    the data-parallel batch sharding — it already picked)."""
+    if mesh is None:
+        return x
+    U = P.UNCONSTRAINED
+    parts = tuple(
+        a
+        if (a is U or a is None or (a in mesh.shape and mesh.shape[a] > 1))
+        else None
+        for a in tuple(spec)
+    )
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*parts)))
+
+
+def _model_last_spec(ndim: int, last) -> P:
+    """Spec constraining only the LAST dim (to `last`); every other dim is
+    left unconstrained so batch/sequence shardings survive the TP layers."""
+    parts = [P.UNCONSTRAINED] * ndim
+    parts[-1] = last
+    return P(*parts)
+
+
+# Megatron mappings re-expressed as sharding constraints. Under pjit these
+# compile to the same collectives Megatron issues by hand
+# (copy_to / reduce_from / scatter_to / gather_from _model_parallel_region).
+
+
+def copy_to_model_parallel_region(x, mesh=None):
+    """Identity fwd, all-reduce bwd in Megatron; a no-op layout-wise."""
+    return x
+
+
+def reduce_from_model_parallel_region(x, mesh=None):
+    """Partial-sum -> model-replicated: constraining the output of a
+    row-parallel contraction to 'no model axis on the feature dim' makes XLA
+    emit the psum. Batch dims stay unconstrained (DP sharding survives)."""
+    return constrain(x, _model_last_spec(x.ndim, None), mesh)
+
+
+def scatter_to_model_parallel_region(x, mesh=None):
+    """-> sharded on last dim over the model axis."""
+    return constrain(x, _model_last_spec(x.ndim, MODEL_AXIS), mesh)
+
+
+def gather_from_model_parallel_region(x, mesh=None):
+    """Sharded on last dim -> model-replicated (all-gather)."""
+    return constrain(x, _model_last_spec(x.ndim, None), mesh)
+
+
+# ------------------------------------------------------------------ #
+# TP layers (pipeline-module compatible)
+# ------------------------------------------------------------------ #
+
+
+from ..runtime.pipe.module import Layer as _PipeLayer
+
+
+class _TPLayerBase(_PipeLayer):
+    """Pipeline-protocol Layer (runtime/pipe/module.py) carrying TP
+    PartitionSpecs in .specs so PipelineModule / LayerSpec accept TP layers
+    directly."""
+
+    specs: Any = None
+
+
+class ColumnParallelLinear(_TPLayerBase):
+    """Y = X W + b with W (in, out) sharded on out.
+
+    ``gather_output=True`` replicates Y afterwards (Megatron semantics);
+    default False keeps Y column-sharded for a following RowParallelLinear.
+    """
+
+    def __init__(self, in_dim: int, out_dim: int, bias: bool = True,
+                 gather_output: bool = False, mesh: Optional[Mesh] = None,
+                 init_scale: float = 0.02):
+        self.in_dim, self.out_dim, self.bias = in_dim, out_dim, bias
+        self.gather_output = gather_output
+        self.mesh = mesh
+        self.init_scale = init_scale
+        self.specs = {"w": column_parallel_spec()}
+        if bias:
+            self.specs["b"] = P(MODEL_AXIS)
+
+    def init(self, rng):
+        w = jax.random.normal(rng, (self.in_dim, self.out_dim), jnp.float32)
+        p = {"w": w * self.init_scale}
+        if self.bias:
+            p["b"] = jnp.zeros((self.out_dim,), jnp.float32)
+        return p
+
+    def apply(self, params, x, rng=None):
+        w = params["w"].astype(x.dtype)
+        y = x @ w
+        if self.bias:
+            y = y + params["b"].astype(x.dtype)
+        if self.gather_output:
+            y = gather_from_model_parallel_region(y, self.mesh)
+        else:
+            y = constrain(y, _model_last_spec(y.ndim, MODEL_AXIS), self.mesh)
+        return y
+
+
+class RowParallelLinear(_TPLayerBase):
+    """Y = X W + b with W (in, out) sharded on in.
+
+    ``input_is_parallel=True`` means X arrives column-sharded from a
+    ColumnParallelLinear; the contraction over the sharded dim produces
+    partial sums which the output constraint turns into an XLA psum —
+    the automatic analog of Megatron's explicit all_reduce.
+    """
+
+    def __init__(self, in_dim: int, out_dim: int, bias: bool = True,
+                 input_is_parallel: bool = True, mesh: Optional[Mesh] = None,
+                 init_scale: float = 0.02):
+        self.in_dim, self.out_dim, self.bias = in_dim, out_dim, bias
+        self.input_is_parallel = input_is_parallel
+        self.mesh = mesh
+        self.init_scale = init_scale
+        self.specs = {"w": row_parallel_spec()}
+        if bias:
+            self.specs["b"] = P(None)
+
+    def init(self, rng):
+        w = jax.random.normal(rng, (self.in_dim, self.out_dim), jnp.float32)
+        p = {"w": w * self.init_scale}
+        if self.bias:
+            p["b"] = jnp.zeros((self.out_dim,), jnp.float32)
+        return p
+
+    def apply(self, params, x, rng=None):
+        if not self.input_is_parallel:
+            x = scatter_to_model_parallel_region(x, self.mesh)
+        w = params["w"].astype(x.dtype)
+        y = x @ w
+        y = reduce_from_model_parallel_region(y, self.mesh)
+        if self.bias:
+            y = y + params["b"].astype(x.dtype)
+        return y
+
+
+class VocabParallelEmbedding(_TPLayerBase):
+    """Embedding with the table sharded over d_model columns.
+
+    Megatron shards over vocab rows and masks+psums; XLA's SPMD partitioner
+    handles a vocab-sharded gather by replicating the table, so the TPU-native
+    layout shards the embedding DIM instead — the gather is then local and the
+    output comes out column-sharded (same layout a column-parallel layer
+    produces). See also models/gpt.py param_specs.
+    """
+
+    def __init__(self, vocab: int, dim: int, mesh: Optional[Mesh] = None):
+        self.vocab, self.dim, self.mesh = vocab, dim, mesh
+        self.specs = {"w": P(None, MODEL_AXIS)}
+
+    def init(self, rng):
+        return {"w": jax.random.normal(rng, (self.vocab, self.dim), jnp.float32) * 0.02}
+
+    def apply(self, params, x, rng=None):
+        y = jnp.take(params["w"], x, axis=0)
+        return constrain(y, _model_last_spec(y.ndim, MODEL_AXIS), self.mesh)
+
+
+class ParallelMLP(_TPLayerBase):
+    """Column-parallel up-proj + gelu + row-parallel down-proj: one model-axis
+    psum per MLP, the canonical Megatron pairing."""
+
+    def __init__(self, d_model: int, d_ff: int, mesh: Optional[Mesh] = None):
+        self.up = ColumnParallelLinear(d_model, d_ff, mesh=mesh)
+        self.down = RowParallelLinear(d_ff, d_model, mesh=mesh)
+        self.specs = {"up": self.up.specs, "down": self.down.specs}
+
+    def init(self, rng):
+        k1, k2 = jax.random.split(rng)
+        return {"up": self.up.init(k1), "down": self.down.init(k2)}
+
+    def apply(self, params, x, rng=None):
+        h = self.up.apply(params["up"], x)
+        h = jax.nn.gelu(h, approximate=True)
+        return self.down.apply(params["down"], h)
+
+
+# ------------------------------------------------------------------ #
+# mpu-compatible adapter
+# ------------------------------------------------------------------ #
+
+
+class ModelParallelUnit:
+    """Megatron-mpu-compatible facade over a jax Mesh.
+
+    The reference engine calls get_model_parallel_rank/world_size/group and
+    get_data_parallel_* on whatever object the user passes as ``mpu``
+    (runtime/engine.py:630-641). Group queries return the mesh axis NAME —
+    under XLA, collectives address axes by name, so the name is the group.
+    """
+
+    def __init__(self, mesh: Mesh, process_index: Optional[int] = None):
+        self.mesh = mesh
+        self._pidx = jax.process_index() if process_index is None else process_index
+        shape = dict(mesh.shape)
+        self._mp = int(shape.get(MODEL_AXIS, 1))
+        self._dp = int(shape.get(DATA_AXIS, 1))
+        self._pp = int(shape.get(PIPE_AXIS, 1))
+        self._sp = int(shape.get(SEQ_AXIS, 1))
+
+    # --- coords of this *process* (multi-host). On one host all ranks are 0.
+    def _coord(self, axis: str) -> int:
+        if axis not in self.mesh.shape:
+            return 0
+        # first local device's coordinate along the axis
+        axis_idx = list(self.mesh.axis_names).index(axis)
+        local = jax.local_devices()[0]
+        pos = np.argwhere(self.mesh.devices == local)
+        if pos.size == 0:
+            return 0
+        return int(pos[0][axis_idx])
+
+    def get_model_parallel_rank(self) -> int:
+        return self._coord(MODEL_AXIS)
+
+    def get_model_parallel_world_size(self) -> int:
+        return self._mp
+
+    def get_model_parallel_group(self) -> str:
+        return MODEL_AXIS
+
+    def get_data_parallel_rank(self) -> int:
+        return self._coord(DATA_AXIS)
+
+    def get_data_parallel_world_size(self) -> int:
+        return self._dp
+
+    def get_data_parallel_group(self) -> str:
+        return DATA_AXIS
+
+    def get_pipe_parallel_rank(self) -> int:
+        return self._coord(PIPE_AXIS)
+
+    def get_pipe_parallel_world_size(self) -> int:
+        return self._pp
+
+    def get_sequence_parallel_world_size(self) -> int:
+        return self._sp
+
+    def get_sequence_parallel_group(self) -> str:
+        return SEQ_AXIS

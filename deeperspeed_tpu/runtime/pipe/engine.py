@@ -64,7 +64,16 @@ def _stage_meshes(mesh: Optional[Mesh], num_stages: int) -> List[Mesh]:
                 rest = rest_names
             out.append(Mesh(devs, rest))
         return out
-    # No pipe axis: round-robin devices over stages (or share device 0).
+    if mesh is not None:
+        # A mesh without a 'pipe' axis would silently drop its data axis
+        # (dp=1) while initialize() validated the batch triple against the
+        # full mesh — refuse instead of training on the wrong batch size.
+        raise ValueError(
+            f"PipelineEngine needs a mesh with a '{PIPE_AXIS}' axis sized "
+            f"num_stages={num_stages}; got axes {mesh.axis_names}. Build one "
+            f"with build_mesh({{'pipe': {num_stages}, 'data': -1}})."
+        )
+    # No mesh given: round-robin devices over stages (or share device 0).
     devices = jax.devices()
     out = []
     for s in range(num_stages):

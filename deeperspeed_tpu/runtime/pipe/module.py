@@ -181,10 +181,12 @@ class PipelineModule:
         # building work
         self._orig = list(layers)
 
-        self.parts = self._partition_layers(partition_method)
-        # build every layer once (host-side objects are cheap; params are the
-        # expensive part and are created per-stage in init_params)
+        # build every layer once BEFORE partitioning so the 'parameters'
+        # method's param counting reuses these instead of re-constructing
+        # (host-side objects are cheap; params are the expensive part and are
+        # created per-stage in init_params)
         self._built = [self._build_layer(i) for i in range(len(self._layer_specs))]
+        self.parts = self._partition_layers(partition_method)
         self.tied_specs: Dict[str, List[int]] = {}
         for i, spec in enumerate(self._layer_specs):
             if isinstance(spec, TiedLayerSpec):

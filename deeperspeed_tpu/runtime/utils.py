@@ -37,16 +37,6 @@ def call_to_str(base, *args, **kwargs) -> str:
 # ------------------------------------------------------------------ #
 
 
-def prefix_sum_inc(weights: Sequence[int]) -> List[int]:
-    """Inclusive prefix sum."""
-    out = []
-    total = 0
-    for w in weights:
-        total += w
-        out.append(total)
-    return out
-
-
 def partition_uniform(num_items: int, num_parts: int) -> List[int]:
     """Evenly split ``num_items`` into ``num_parts`` contiguous ranges.
 
