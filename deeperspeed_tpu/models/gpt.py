@@ -48,7 +48,9 @@ class GPTConfig:
     tie_embeddings: bool = False
     remat: bool = True
     dtype: Any = jnp.bfloat16  # compute dtype for activations
-    attn_impl: str = "auto"  # 'auto' | 'pallas' | 'xla'
+    # 'auto' | 'pallas' | 'xla' | 'ring' | 'ulysses' (the last two are the
+    # context-parallel paths over the 'seq' mesh axis)
+    attn_impl: str = "auto"
 
     @property
     def ffn_dim(self):
@@ -190,7 +192,12 @@ def _xla_causal_attention(q, k, v):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+_ATTN_IMPLS = ("auto", "pallas", "pallas_interpret", "xla", "ring", "ulysses")
+
+
 def causal_attention(q, k, v, impl="auto"):
+    if impl not in _ATTN_IMPLS:
+        raise ValueError(f"unknown attn_impl {impl!r}; choose from {_ATTN_IMPLS}")
     if impl in ("auto", "pallas", "pallas_interpret"):
         from ..ops.pallas.flash_attention import flash_attention, is_available
 
@@ -209,14 +216,13 @@ def causal_attention(q, k, v, impl="auto"):
 def _shard_act(x, mesh, spec):
     if mesh is None:
         return x
-    # drop axis names the mesh doesn't have (e.g. 'seq' on a dp x tp mesh)
-    parts = tuple(
-        a if (a is not None and a in mesh.shape and mesh.shape[a] > 1) else None
-        for a in tuple(spec)
-    )
     from jax.sharding import NamedSharding
 
-    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*parts)))
+    from ..parallel.topology import filter_spec
+
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, filter_spec(spec, mesh))
+    )
 
 
 def make_gpt(cfg: GPTConfig, mesh=None):
@@ -225,6 +231,20 @@ def make_gpt(cfg: GPTConfig, mesh=None):
     apply_fn(params, tokens) -> logits (B, S, V)
     loss_fn(params, batch) with batch = tokens (B, S+1) or (inputs, targets)
     """
+
+    cp_attend = None
+    if cfg.attn_impl in ("ring", "ulysses"):
+        if mesh is None:
+            raise ValueError(
+                f"attn_impl={cfg.attn_impl!r} is a context-parallel strategy "
+                "and needs a mesh with a 'seq' axis; pass mesh= to make_gpt"
+            )
+        from ..ops.ring_attention import make_context_parallel_attention
+
+        # raises if the mesh has no usable 'seq' axis — never silently dense
+        cp_attend = make_context_parallel_attention(
+            mesh, strategy=cfg.attn_impl, causal=True
+        )
 
     def block(carry, layer_params, positions):
         x = carry  # (B, S, D) compute dtype
@@ -246,7 +266,10 @@ def make_gpt(cfg: GPTConfig, mesh=None):
         q = _shard_act(q, mesh, P(DATA_AXIS, SEQ_AXIS, MODEL_AXIS, None))
         k = _shard_act(k, mesh, P(DATA_AXIS, SEQ_AXIS, MODEL_AXIS, None))
         v = _shard_act(v, mesh, P(DATA_AXIS, SEQ_AXIS, MODEL_AXIS, None))
-        attn = causal_attention(q, k, v, impl=cfg.attn_impl)
+        if cp_attend is not None:
+            attn = cp_attend(q, k, v)
+        else:
+            attn = causal_attention(q, k, v, impl=cfg.attn_impl)
         attn = attn.reshape(B, S, D)
         attn_out = attn @ layer_params["attn"]["wo"].astype(cdt) + layer_params[
             "attn"

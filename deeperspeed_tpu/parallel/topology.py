@@ -252,6 +252,31 @@ def build_mesh(
     return Mesh(mesh_devices, tuple(dims.keys()))
 
 
+def filter_spec(spec, mesh):
+    """Drop PartitionSpec axis names a mesh doesn't carry (or carries at
+    size 1), so a model's canonical specs (naming e.g. 'model'/'seq') work on
+    any mesh shape. Entries may be axis names, tuples of names, None, or
+    ``P.UNCONSTRAINED``. The single source of truth for this rule — used by
+    ZeRO spec derivation, TP layers, and model sharding constraints."""
+    if spec is None or mesh is None:
+        return spec
+    from jax.sharding import PartitionSpec as P
+
+    def keep(a):
+        return a in mesh.shape and mesh.shape[a] > 1
+
+    parts = []
+    for a in tuple(spec):
+        if a is None or a is P.UNCONSTRAINED:
+            parts.append(a)
+        elif isinstance(a, tuple):
+            kept = tuple(x for x in a if keep(x))
+            parts.append(kept if len(kept) > 1 else (kept[0] if kept else None))
+        else:
+            parts.append(a if keep(a) else None)
+    return P(*parts)
+
+
 def single_device_mesh(axis_names=(DATA_AXIS,)):
     """A trivial mesh over one device (useful for tests / single chip)."""
     import jax

@@ -29,7 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .topology import DATA_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS
+from .topology import DATA_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS, filter_spec
 
 
 # ------------------------------------------------------------------ #
@@ -67,14 +67,9 @@ def constrain(x, spec: P, mesh: Optional[Mesh]):
     the data-parallel batch sharding — it already picked)."""
     if mesh is None:
         return x
-    U = P.UNCONSTRAINED
-    parts = tuple(
-        a
-        if (a is U or a is None or (a in mesh.shape and mesh.shape[a] > 1))
-        else None
-        for a in tuple(spec)
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, filter_spec(spec, mesh))
     )
-    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*parts)))
 
 
 def _model_last_spec(ndim: int, last) -> P:

@@ -28,7 +28,7 @@ from typing import Optional
 import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ...parallel.topology import DATA_AXIS
+from ...parallel.topology import DATA_AXIS, filter_spec
 
 
 def _axis_size(mesh, name) -> int:
@@ -89,6 +89,8 @@ def grad_spec(leaf, tp_spec: Optional[P], stage: int, data_size: int) -> P:
     return base
 
 
+
+
 def tree_specs(params, tp_specs, stage: int, mesh, kind: str):
     """Map a params pytree (+ optional tp spec pytree) to a spec pytree.
 
@@ -98,7 +100,9 @@ def tree_specs(params, tp_specs, stage: int, mesh, kind: str):
     fn = {"param": param_spec, "master": master_spec, "grad": grad_spec}[kind]
     if tp_specs is None:
         return jax.tree.map(lambda p: fn(p, None, stage, data_size), params)
-    return jax.tree.map(lambda p, s: fn(p, s, stage, data_size), params, tp_specs)
+    return jax.tree.map(
+        lambda p, s: fn(p, filter_spec(s, mesh), stage, data_size), params, tp_specs
+    )
 
 
 def named_shardings(mesh, specs):
