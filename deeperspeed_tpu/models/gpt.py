@@ -198,6 +198,12 @@ _ATTN_IMPLS = ("auto", "pallas", "pallas_interpret", "xla", "ring", "ulysses")
 def causal_attention(q, k, v, impl="auto"):
     if impl not in _ATTN_IMPLS:
         raise ValueError(f"unknown attn_impl {impl!r}; choose from {_ATTN_IMPLS}")
+    if impl in ("ring", "ulysses"):
+        raise ValueError(
+            f"attn_impl {impl!r} is context-parallel and needs a mesh; use "
+            "ops.ring_attention.make_context_parallel_attention (make_gpt "
+            "wires it automatically when given a mesh)"
+        )
     if impl in ("auto", "pallas", "pallas_interpret"):
         from ..ops.pallas.flash_attention import flash_attention, is_available
 

@@ -1,0 +1,251 @@
+"""Block-sparse attention tests (reference test_sparse_attention.py analog):
+layout families' structural properties + Pallas kernel (interpret mode)
+vs the dense-masked XLA reference, fwd and grads.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeperspeed_tpu.ops.sparse_attention import (
+    BigBirdSparsityConfig,
+    BSLongformerSparsityConfig,
+    DenseSparsityConfig,
+    FixedSparsityConfig,
+    LocalSlidingWindowSparsityConfig,
+    SparseSelfAttention,
+    VariableSparsityConfig,
+    block_sparse_attention_xla,
+    build_lut,
+    make_block_sparse_attention,
+)
+
+H, BLOCK = 2, 8
+
+
+def _qkv(key, B=2, S=64, heads=H, Dh=16, dtype=jnp.float32):
+    ks = jax.random.split(key, 3)
+    return tuple(jax.random.normal(k, (B, S, heads, Dh), dtype) for k in ks)
+
+
+# ------------------------- layout families ------------------------- #
+
+
+def test_dense_layout_full():
+    layout = DenseSparsityConfig(num_heads=H, block=BLOCK).make_layout(64)
+    assert layout.shape == (H, 8, 8)
+    assert layout.all()
+
+
+def test_fixed_layout_unidirectional_lower_triangular():
+    cfg = FixedSparsityConfig(num_heads=H, block=BLOCK, num_local_blocks=2,
+                              num_global_blocks=1, attention="unidirectional")
+    layout = cfg.make_layout(64)
+    assert not np.triu(layout[0], 1).any()  # nothing above the diagonal
+    # every diagonal block attends to itself
+    assert all(layout[0, i, i] for i in range(8))
+
+
+def test_fixed_layout_bidirectional_local_windows():
+    cfg = FixedSparsityConfig(num_heads=H, block=BLOCK, num_local_blocks=4,
+                              num_global_blocks=1)
+    layout = cfg.make_layout(64)
+    # dense 4-block local windows on the diagonal
+    assert layout[0, 0:4, 0:4].all() and layout[0, 4:8, 4:8].all()
+    # global column (last block of each window) visible to all rows
+    assert layout[0, :, 3].all() and layout[0, :, 7].all()
+
+
+def test_fixed_different_global_patterns_per_head():
+    cfg = FixedSparsityConfig(
+        num_heads=4, block=BLOCK, num_local_blocks=4, num_global_blocks=1,
+        different_layout_per_head=True, num_different_global_patterns=4,
+    )
+    layout = cfg.make_layout(64)
+    # head h uses global column 3-h within each window
+    for h in range(4):
+        assert layout[h, :, 3 - h].all()
+    assert not np.array_equal(layout[0], layout[1])
+
+
+def test_variable_layout_globals_and_windows():
+    cfg = VariableSparsityConfig(
+        num_heads=H, block=BLOCK, num_random_blocks=0,
+        local_window_blocks=[2, 4], global_block_indices=[0],
+    )
+    layout = cfg.make_layout(64)
+    assert layout[0, :, 0].all()  # global column 0
+    assert layout[0, 0:2, 0:2].all() and layout[0, 2:6, 2:6].all()
+
+
+def test_bigbird_layout():
+    cfg = BigBirdSparsityConfig(num_heads=H, block=BLOCK, num_random_blocks=1,
+                                num_sliding_window_blocks=3, num_global_blocks=1)
+    layout = cfg.make_layout(64)
+    assert layout[0, 0, :].all() and layout[0, :, 0].all()  # ITC global
+    for i in range(1, 7):  # sliding window band
+        assert layout[0, i, i - 1] and layout[0, i, i] and layout[0, i, i + 1]
+
+
+def test_bslongformer_layout():
+    cfg = BSLongformerSparsityConfig(num_heads=H, block=BLOCK,
+                                     num_sliding_window_blocks=3,
+                                     global_block_indices=[0])
+    layout = cfg.make_layout(64)
+    assert layout[0, 0, :].all() and layout[0, :, 0].all()
+    assert layout[0, 3, 2] and layout[0, 3, 3] and layout[0, 3, 4]
+
+
+def test_local_sliding_window_layout():
+    cfg = LocalSlidingWindowSparsityConfig(num_heads=H, block=BLOCK,
+                                           num_sliding_window_blocks=3)
+    layout = cfg.make_layout(64)
+    assert not np.triu(layout[0], 1).any()  # unidirectional default
+    assert layout[0, 5, 4] and layout[0, 5, 5] and not layout[0, 5, 2]
+
+
+def test_layout_seq_not_divisible_raises():
+    with pytest.raises(ValueError, match="dividable by Block size"):
+        DenseSparsityConfig(num_heads=H, block=BLOCK).make_layout(60)
+
+
+def test_build_lut():
+    layout = np.zeros((1, 4, 4), np.int64)
+    layout[0, 0, 0] = 1
+    layout[0, 2, 1] = layout[0, 2, 3] = 1
+    cols, counts = build_lut(layout)
+    assert counts.tolist() == [[1, 0, 2, 0]]
+    assert cols.shape == (1, 4, 2)
+    assert cols[0, 2].tolist() == [1, 3]
+    assert cols[0, 0].tolist() == [0, 0]  # padded with last valid
+
+
+# ------------------------- kernel numerics ------------------------- #
+
+
+def _dense_ref(q, k, v, layout, causal):
+    return block_sparse_attention_xla(q, k, v, layout, BLOCK, causal=causal)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_kernel_matches_dense_mask_fixed(causal):
+    cfg = FixedSparsityConfig(
+        num_heads=H, block=BLOCK, num_local_blocks=2, num_global_blocks=1,
+        attention="unidirectional" if causal else "bidirectional",
+    )
+    layout = cfg.make_layout(64)
+    q, k, v = _qkv(jax.random.PRNGKey(0))
+    attend = make_block_sparse_attention(layout, BLOCK, causal=causal,
+                                         interpret=True)
+    out = jax.jit(attend)(q, k, v)
+    ref = _dense_ref(q, k, v, layout, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5,
+                               atol=2e-5)
+
+
+def test_kernel_matches_dense_mask_bigbird():
+    cfg = BigBirdSparsityConfig(num_heads=H, block=BLOCK, num_random_blocks=1,
+                                num_sliding_window_blocks=3, num_global_blocks=1)
+    layout = cfg.make_layout(64)
+    q, k, v = _qkv(jax.random.PRNGKey(1))
+    attend = make_block_sparse_attention(layout, BLOCK, interpret=True)
+    out = jax.jit(attend)(q, k, v)
+    ref = _dense_ref(q, k, v, layout, False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5,
+                               atol=2e-5)
+
+
+def test_kernel_empty_rows_zero_output():
+    """A head whose layout row has no blocks must emit zeros, not NaNs."""
+    layout = np.zeros((1, 4, 4), np.int64)
+    layout[0, 0, 0] = 1  # only the first block row attends anywhere
+    q, k, v = _qkv(jax.random.PRNGKey(2), B=1, S=32, heads=1)
+    attend = make_block_sparse_attention(layout, BLOCK, interpret=True)
+    out = np.asarray(jax.jit(attend)(q, k, v))
+    assert np.isfinite(out).all()
+    assert np.abs(out[:, 8:]).max() == 0.0  # rows beyond block 0: no keys
+
+
+def test_kernel_grads_match_dense_mask():
+    cfg = BSLongformerSparsityConfig(num_heads=H, block=BLOCK,
+                                     num_sliding_window_blocks=3)
+    layout = cfg.make_layout(32)
+    q, k, v = _qkv(jax.random.PRNGKey(3), S=32)
+    attend = make_block_sparse_attention(layout, BLOCK, interpret=True)
+
+    g_sparse = jax.jit(jax.grad(lambda q, k, v: jnp.sum(attend(q, k, v) ** 2),
+                                argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.grad(
+        lambda q, k, v: jnp.sum(_dense_ref(q, k, v, layout, False) ** 2),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b in zip(g_sparse, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=5e-4,
+                                   atol=5e-4)
+
+
+# ------------------------- module API ------------------------------ #
+
+
+def test_sparse_self_attention_module():
+    cfg = FixedSparsityConfig(num_heads=H, block=BLOCK, num_local_blocks=2,
+                              attention="unidirectional")
+    attn = SparseSelfAttention(cfg, max_seq_length=128, impl="pallas_interpret")
+    q, k, v = _qkv(jax.random.PRNGKey(4), S=64)
+    # module convention is (B, H, S, Dh)
+    t = lambda x: x.transpose(0, 2, 1, 3)
+    out = attn(t(q), t(k), t(v))
+    assert out.shape == t(q).shape
+    ref = _dense_ref(q, k, v, cfg.make_layout(64), True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(t(ref)), rtol=2e-5,
+                               atol=2e-5)
+    # layout slicing: shorter sequence reuses the master layout
+    q2, k2, v2 = _qkv(jax.random.PRNGKey(5), S=32)
+    out2 = attn(t(q2), t(k2), t(v2))
+    assert out2.shape == t(q2).shape
+
+
+def test_sparse_self_attention_key_padding_mask():
+    cfg = DenseSparsityConfig(num_heads=H, block=BLOCK)
+    attn = SparseSelfAttention(cfg, max_seq_length=64, causal=False)
+    q, k, v = _qkv(jax.random.PRNGKey(6), B=1, S=32)
+    t = lambda x: x.transpose(0, 2, 1, 3)
+    kpm = np.zeros((1, 32), np.float32)
+    kpm[:, 16:] = -1e30  # drop the second half of the keys
+    out = attn(t(q), t(k), t(v), key_padding_mask=jnp.asarray(kpm))
+    # equivalent: dense attention of all queries over only the first 16 keys
+    ref = block_sparse_attention_xla(
+        q, k[:, :16], v[:, :16], np.ones((H, 4, 2), np.int64), BLOCK,
+        causal=False,
+    )
+    np.testing.assert_allclose(np.asarray(t(out)), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_sparsity_config_from_dict():
+    from deeperspeed_tpu.ops.sparse_attention import sparsity_config_from_dict
+
+    cfg = sparsity_config_from_dict(
+        8, {"mode": "bigbird", "block": 32, "num_sliding_window_blocks": 5}
+    )
+    assert isinstance(cfg, BigBirdSparsityConfig)
+    assert cfg.block == 32 and cfg.num_sliding_window_blocks == 5
+    with pytest.raises(NotImplementedError, match="has not been implemented"):
+        sparsity_config_from_dict(8, {"mode": "nope"})
+
+
+def test_bert_sparse_self_attention():
+    from deeperspeed_tpu.ops.sparse_attention import BertSparseSelfAttention
+
+    mod = BertSparseSelfAttention(
+        hidden_size=32, num_heads=H,
+        sparsity_config=FixedSparsityConfig(num_heads=H, block=BLOCK,
+                                            num_local_blocks=2),
+        max_seq_length=64,
+    )
+    params = mod.init(jax.random.PRNGKey(7))
+    hidden = jax.random.normal(jax.random.PRNGKey(8), (2, 64, 32))
+    out = mod.apply(params, hidden)
+    assert out.shape == hidden.shape
+    assert np.isfinite(np.asarray(out)).all()
