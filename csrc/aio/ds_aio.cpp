@@ -1,0 +1,461 @@
+// TPU-host async file I/O library for ZeRO-Infinity style NVMe offload.
+//
+// Re-implements the capability of the reference DeepSpeed aio op
+// (csrc/aio/py_lib/deepspeed_py_aio_handle.cpp, csrc/aio/common/*) for the
+// TPU-VM host, with a flat C ABI consumed from Python via ctypes (pybind11 is
+// not available in this image).
+//
+// Two I/O engines, chosen per-file at submit time:
+//   1. Linux-native AIO (raw io_setup/io_submit/io_getevents syscalls -- no
+//      libaio needed) with O_DIRECT block-aligned transfers. This is the
+//      "real" NVMe path: the kernel queues requests on the device.
+//   2. A thread-pool pread/pwrite fallback for filesystems that refuse
+//      O_DIRECT (overlayfs, tmpfs) -- still asynchronous with respect to the
+//      caller, just without kernel-level queueing.
+//
+// Handle semantics mirror the reference aio_handle
+// (csrc/aio/py_lib/deepspeed_py_aio_handle.h:23-59): block_size, queue_depth,
+// single_submit, overlap_events, thread_count; sync_pread/sync_pwrite,
+// async_pread/async_pwrite + wait.
+
+#include <linux/aio_abi.h>
+#include <sys/stat.h>
+#include <sys/syscall.h>
+#include <sys/types.h>
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <condition_variable>
+#include <deque>
+#include <fcntl.h>
+#include <memory>
+#include <mutex>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// Raw Linux AIO syscall wrappers (libaio is just this, thinly).
+// ---------------------------------------------------------------------------
+inline int sys_io_setup(unsigned nr, aio_context_t* ctx) {
+    return syscall(SYS_io_setup, nr, ctx);
+}
+inline int sys_io_destroy(aio_context_t ctx) {
+    return syscall(SYS_io_destroy, ctx);
+}
+inline int sys_io_submit(aio_context_t ctx, long n, struct iocb** iocbs) {
+    return syscall(SYS_io_submit, ctx, n, iocbs);
+}
+inline int sys_io_getevents(aio_context_t ctx, long min_nr, long nr,
+                            struct io_event* events, struct timespec* ts) {
+    return syscall(SYS_io_getevents, ctx, min_nr, nr, events, ts);
+}
+
+struct Parent;
+
+struct AioRequest {
+    int op;  // 0 = read, 1 = write
+    int fd;
+    char* buffer;
+    int64_t file_offset;
+    int64_t nbytes;
+    bool use_kernel_aio;  // O_DIRECT + io_submit path
+    // completion bookkeeping; shared ownership so the Parent outlives the
+    // waiter even if it wakes between our unlock and notify
+    std::shared_ptr<Parent> parent;
+};
+
+struct Parent {
+    std::mutex mu;
+    std::condition_variable cv;
+    int64_t bytes_done = 0;
+    int64_t bytes_expected = 0;
+    int error = 0;
+    int fd = -1;
+    bool close_fd_on_done = false;
+    int pending_shards = 0;
+};
+
+// One worker thread: owns its own aio context so queue-depth applies per
+// thread, as in the reference (deepspeed_aio_thread.cpp).
+class Worker {
+public:
+    Worker(int block_size, int queue_depth, bool single_submit, bool overlap_events)
+        : block_size_(block_size),
+          queue_depth_(queue_depth),
+          single_submit_(single_submit),
+          overlap_events_(overlap_events) {
+        ctx_ = 0;
+        if (sys_io_setup(queue_depth_, &ctx_) != 0) ctx_ = 0;  // fallback only
+        th_ = std::thread([this] { run(); });
+    }
+
+    ~Worker() {
+        {
+            std::lock_guard<std::mutex> g(mu_);
+            stop_ = true;
+        }
+        cv_.notify_all();
+        th_.join();
+        if (ctx_) sys_io_destroy(ctx_);
+    }
+
+    void submit(const AioRequest& r) {
+        {
+            std::lock_guard<std::mutex> g(mu_);
+            q_.push_back(r);
+        }
+        cv_.notify_one();
+    }
+
+private:
+    void run() {
+        for (;;) {
+            AioRequest r;
+            {
+                std::unique_lock<std::mutex> lk(mu_);
+                cv_.wait(lk, [this] { return stop_ || !q_.empty(); });
+                if (stop_ && q_.empty()) return;
+                r = q_.front();
+                q_.pop_front();
+            }
+            int64_t done = (r.use_kernel_aio && ctx_) ? run_kernel_aio(r) : run_psync(r);
+            finish(r, done);
+        }
+    }
+
+    // Kernel-queued path: chop the shard into block_size iocbs, keep up to
+    // queue_depth in flight. single_submit submits iocbs one syscall each vs
+    // batched; overlap_events refills the queue as completions arrive vs
+    // draining each wave fully (the reference's two submit/drain strategies,
+    // csrc/aio/common/deepspeed_aio_common.cpp).
+    int64_t run_kernel_aio(const AioRequest& r) {
+        const int64_t nblocks = (r.nbytes + block_size_ - 1) / block_size_;
+        const int nslots = (int)std::min<int64_t>(nblocks, queue_depth_);
+        std::vector<struct iocb> iocbs(nslots);
+        std::vector<int> free_slots;
+        for (int i = nslots - 1; i >= 0; --i) free_slots.push_back(i);
+        std::vector<struct io_event> events(nslots);
+        int64_t next_block = 0, completed_bytes = 0;
+        int inflight = 0;
+        bool error = false;
+
+        auto fill_queue = [&]() {
+            std::vector<struct iocb*> batch;
+            while (next_block < nblocks && !free_slots.empty()) {
+                int slot = free_slots.back();
+                free_slots.pop_back();
+                int64_t off = next_block * (int64_t)block_size_;
+                int64_t len = std::min<int64_t>(block_size_, r.nbytes - off);
+                // O_DIRECT needs aligned lengths; shard sizes are kAlign
+                // multiples by construction (see submit()), so len already is.
+                struct iocb* cb = &iocbs[slot];
+                memset(cb, 0, sizeof(*cb));
+                cb->aio_fildes = r.fd;
+                cb->aio_lio_opcode = r.op == 0 ? IOCB_CMD_PREAD : IOCB_CMD_PWRITE;
+                cb->aio_buf = (uint64_t)(r.buffer + off);
+                cb->aio_offset = r.file_offset + off;
+                cb->aio_nbytes = (uint64_t)len;
+                cb->aio_data = (uint64_t)len;
+                batch.push_back(cb);
+                ++next_block;
+                if (single_submit_) break;
+            }
+            int submitted = 0;
+            while (submitted < (int)batch.size()) {
+                int rc = sys_io_submit(ctx_, batch.size() - submitted,
+                                       batch.data() + submitted);
+                if (rc <= 0) break;
+                submitted += rc;
+            }
+            inflight += submitted;
+            // return un-submitted blocks to the pool
+            for (int i = (int)batch.size() - 1; i >= submitted; --i) {
+                free_slots.push_back((int)(batch[i] - iocbs.data()));
+                --next_block;
+            }
+        };
+
+        fill_queue();
+        if (inflight == 0) return run_psync(r);  // submission refused; fall back
+
+        while (inflight > 0) {
+            int min_nr = overlap_events_ ? 1 : inflight;
+            int got = sys_io_getevents(ctx_, min_nr, nslots, events.data(), nullptr);
+            if (got <= 0) {
+                error = true;
+                break;
+            }
+            for (int i = 0; i < got; ++i) {
+                struct iocb* done = (struct iocb*)(uintptr_t)events[i].obj;
+                free_slots.push_back((int)(done - iocbs.data()));
+                --inflight;
+                if ((int64_t)events[i].res < (int64_t)events[i].data)
+                    error = true;  // short or failed block
+                else
+                    completed_bytes += (int64_t)events[i].data;
+            }
+            if (!error) fill_queue();
+        }
+        // Drain stragglers on error so the context is clean for reuse.
+        while (inflight > 0) {
+            int got = sys_io_getevents(ctx_, inflight, nslots, events.data(), nullptr);
+            if (got <= 0) break;
+            inflight -= got;
+        }
+        if (error) return -1;
+        return completed_bytes == r.nbytes ? completed_bytes : -1;
+    }
+
+    int64_t run_psync(const AioRequest& r) {
+        int64_t done = 0;
+        while (done < r.nbytes) {
+            int64_t len = std::min<int64_t>(block_size_, r.nbytes - done);
+            ssize_t n = r.op == 0
+                            ? pread(r.fd, r.buffer + done, len, r.file_offset + done)
+                            : pwrite(r.fd, r.buffer + done, len, r.file_offset + done);
+            if (n <= 0) return -1;
+            done += n;
+        }
+        return done;
+    }
+
+    void finish(const AioRequest& r, int64_t done) {
+        std::shared_ptr<Parent> p = r.parent;  // keep alive past notify
+        std::unique_lock<std::mutex> lk(p->mu);
+        if (done < 0)
+            p->error = 1;
+        else
+            p->bytes_done += done;
+        if (--p->pending_shards == 0) {
+            if (p->close_fd_on_done && p->fd >= 0) {
+                if (r.op == 1) fsync(p->fd);
+                close(p->fd);
+                p->fd = -1;
+            }
+            lk.unlock();
+            p->cv.notify_all();
+        }
+    }
+
+public:
+    static constexpr int64_t kAlign = 512;
+
+private:
+    int block_size_, queue_depth_;
+    bool single_submit_, overlap_events_;
+    aio_context_t ctx_;
+    std::thread th_;
+    std::mutex mu_;
+    std::condition_variable cv_;
+    std::deque<AioRequest> q_;
+    bool stop_ = false;
+};
+
+struct Handle {
+    int block_size;
+    int queue_depth;
+    bool single_submit;
+    bool overlap_events;
+    int num_threads;
+    std::vector<std::unique_ptr<Worker>> workers;
+    std::mutex mu;
+    std::vector<std::shared_ptr<Parent>> outstanding;
+    int next_worker = 0;
+};
+
+bool ptr_aligned(const void* p) { return ((uintptr_t)p % Worker::kAlign) == 0; }
+
+// Submit one logical request, sharded across worker threads.
+// Returns a Parent tracking completion, or nullptr on open failure.
+std::shared_ptr<Parent> submit(Handle* h, int op, char* buffer, const char* filename,
+                               int64_t nbytes) {
+    int flags = op == 0 ? O_RDONLY : (O_WRONLY | O_CREAT);
+    bool direct = false;
+    int fd = -1;
+    if (ptr_aligned(buffer)) {
+        fd = open(filename, flags | O_DIRECT, 0644);
+        if (fd >= 0) direct = true;
+    }
+    if (fd < 0) {
+        fd = open(filename, flags, 0644);
+        if (fd < 0) return nullptr;
+    }
+    if (op == 0 && nbytes <= 0) {
+        struct stat st;
+        if (fstat(fd, &st) != 0) {
+            close(fd);
+            return nullptr;
+        }
+        nbytes = st.st_size;
+    }
+    bool kernel_aio = direct && (nbytes % Worker::kAlign == 0);
+    if (direct && !kernel_aio) {
+        // O_DIRECT fd can't serve unaligned psync I/O; reopen buffered.
+        close(fd);
+        direct = false;
+        fd = open(filename, flags, 0644);
+        if (fd < 0) return nullptr;
+    }
+
+    auto parent = std::make_shared<Parent>();
+    parent->bytes_expected = nbytes;
+    parent->fd = fd;
+    parent->close_fd_on_done = true;
+
+    // Shard the byte range across threads in block-size multiples.
+    int nshards = std::min<int64_t>(h->num_threads,
+                                    std::max<int64_t>(1, nbytes / h->block_size));
+    int64_t per = ((nbytes / nshards) + h->block_size - 1) / h->block_size * h->block_size;
+    std::vector<AioRequest> reqs;
+    for (int64_t off = 0, i = 0; off < nbytes; off += per, ++i) {
+        AioRequest r;
+        r.op = op;
+        r.fd = fd;
+        r.buffer = buffer + off;
+        r.file_offset = off;
+        r.nbytes = std::min<int64_t>(per, nbytes - off);
+        r.use_kernel_aio = kernel_aio;
+        r.parent = parent;
+        reqs.push_back(r);
+    }
+    parent->pending_shards = (int)reqs.size();
+    if (op == 1 && kernel_aio) {
+        // Preallocate so O_DIRECT aligned tail writes land inside the file,
+        // then truncate to logical size at close (see wait()).
+        int64_t cap = (nbytes + Worker::kAlign - 1) / Worker::kAlign * Worker::kAlign;
+        if (ftruncate(fd, cap) != 0) { /* non-fatal; psync path still works */ }
+    }
+    for (auto& r : reqs) {
+        h->workers[h->next_worker]->submit(r);
+        h->next_worker = (h->next_worker + 1) % (int)h->workers.size();
+    }
+    return parent;
+}
+
+int64_t wait_parent(Parent* p) {
+    std::unique_lock<std::mutex> lk(p->mu);
+    p->cv.wait(lk, [p] { return p->pending_shards == 0; });
+    if (p->error) return -1;
+    return p->bytes_done >= p->bytes_expected ? p->bytes_expected : p->bytes_done;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ds_aio_handle_new(int block_size, int queue_depth, int single_submit,
+                        int overlap_events, int num_threads) {
+    auto* h = new Handle();
+    h->block_size = block_size > 0 ? block_size : (1 << 20);
+    h->queue_depth = queue_depth > 0 ? queue_depth : 8;
+    h->single_submit = single_submit != 0;
+    h->overlap_events = overlap_events != 0;
+    h->num_threads = num_threads > 0 ? num_threads : 1;
+    for (int i = 0; i < h->num_threads; ++i)
+        h->workers.emplace_back(new Worker(h->block_size, h->queue_depth,
+                                           h->single_submit, h->overlap_events));
+    return h;
+}
+
+void ds_aio_handle_free(void* handle) { delete (Handle*)handle; }
+
+int ds_aio_get_block_size(void* handle) { return ((Handle*)handle)->block_size; }
+int ds_aio_get_queue_depth(void* handle) { return ((Handle*)handle)->queue_depth; }
+int ds_aio_get_single_submit(void* handle) { return ((Handle*)handle)->single_submit; }
+int ds_aio_get_overlap_events(void* handle) { return ((Handle*)handle)->overlap_events; }
+int ds_aio_get_thread_count(void* handle) { return ((Handle*)handle)->num_threads; }
+
+// Synchronous: submit + block until complete. Returns bytes moved or -1.
+long long ds_aio_sync_pread(void* handle, void* buffer, const char* filename,
+                            long long nbytes) {
+    auto p = submit((Handle*)handle, 0, (char*)buffer, filename, nbytes);
+    if (!p) return -1;
+    return wait_parent(p.get());
+}
+
+long long ds_aio_sync_pwrite(void* handle, const void* buffer, const char* filename,
+                             long long nbytes) {
+    Handle* h = (Handle*)handle;
+    auto p = submit(h, 1, (char*)buffer, filename, nbytes);
+    if (!p) return -1;
+    int64_t r = wait_parent(p.get());
+    if (r >= 0) {
+        // Trim O_DIRECT round-up so the on-disk size equals the logical size.
+        if (truncate(filename, nbytes) != 0) { /* ignore on fs without support */ }
+    }
+    return r;
+}
+
+// Asynchronous: returns 0 on successful submission; completion via ds_aio_wait.
+int ds_aio_async_pread(void* handle, void* buffer, const char* filename,
+                       long long nbytes) {
+    Handle* h = (Handle*)handle;
+    auto p = submit(h, 0, (char*)buffer, filename, nbytes);
+    if (!p) return -1;
+    std::lock_guard<std::mutex> g(h->mu);
+    h->outstanding.push_back(p);
+    return 0;
+}
+
+int ds_aio_async_pwrite(void* handle, const void* buffer, const char* filename,
+                        long long nbytes) {
+    Handle* h = (Handle*)handle;
+    auto p = submit(h, 1, (char*)buffer, filename, nbytes);
+    if (!p) return -1;
+    std::lock_guard<std::mutex> g(h->mu);
+    h->outstanding.push_back(p);
+    return 0;
+}
+
+// Block until every outstanding async request on this handle completes.
+// Returns the number of completed requests, or -1 if any failed.
+int ds_aio_wait(void* handle) {
+    Handle* h = (Handle*)handle;
+    std::vector<std::shared_ptr<Parent>> pending;
+    {
+        std::lock_guard<std::mutex> g(h->mu);
+        pending.swap(h->outstanding);
+    }
+    int n = 0, err = 0;
+    for (auto& p : pending) {
+        if (wait_parent(p.get()) < 0) err = 1;
+        ++n;
+    }
+    return err ? -1 : n;
+}
+
+// Aligned pinned-style buffer management for O_DIRECT transfers.
+void* ds_aio_aligned_alloc(long long nbytes) {
+    long long cap = (nbytes + Worker::kAlign - 1) / Worker::kAlign * Worker::kAlign;
+    void* p = nullptr;
+    if (posix_memalign(&p, Worker::kAlign, cap) != 0) return nullptr;
+    return p;
+}
+
+void ds_aio_aligned_free(void* p) { free(p); }
+
+// Parallel memcpy helper (reference: deepspeed_py_copy.cpp) used by the swap
+// buffer pools to stage tensors into aligned buffers without the GIL.
+void ds_aio_memcpy(void* dst, const void* src, long long nbytes, int num_threads) {
+    if (num_threads <= 1 || nbytes < (4 << 20)) {
+        memcpy(dst, src, nbytes);
+        return;
+    }
+    std::vector<std::thread> ts;
+    long long per = (nbytes + num_threads - 1) / num_threads;
+    for (int i = 0; i < num_threads; ++i) {
+        long long off = (long long)i * per;
+        if (off >= nbytes) break;
+        long long len = std::min(per, nbytes - off);
+        ts.emplace_back([=] { memcpy((char*)dst + off, (const char*)src + off, len); });
+    }
+    for (auto& t : ts) t.join();
+}
+
+}  // extern "C"

@@ -90,8 +90,100 @@ class FusedAdam:
 
 
 class DeepSpeedCPUAdam(FusedAdam):
-    """Host-offloaded Adam. Same math as FusedAdam; the engine places its
-    state on the host when ZeRO offload_optimizer.device == 'cpu' (the analog
-    of the AVX cpu_adam kernel /root/reference/csrc/adam/cpu_adam.cpp). A
-    native C++ AVX implementation is used for the offloaded path when built
-    (see csrc/)."""
+    """Host-offloaded Adam (reference deepspeed/ops/adam/cpu_adam.py:12 over
+    csrc/adam/cpu_adam.cpp). Two personalities:
+
+      * as a device optimizer it is identical to FusedAdam (the engine may
+        still run it on-device when no offload is configured);
+      * `step_flat()` is the host path: one AVX-vectorized native Adam step
+        over flat fp32 numpy shards, with optional fused bf16 copy-back of
+        the updated params for device upload (the analog of the reference's
+        `step(fp16_param_groups=...)` fused fp16 write-back).
+
+    Per-instance optimizer ids in the native registry mirror the reference's
+    create_adam/destroy_adam lifecycle.
+    """
+
+    _next_id = 0
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._opt_id = None
+        self._lib = None
+        try:
+            from .op_builder import CPUAdamBuilder
+
+            self._lib = CPUAdamBuilder().load()
+            DeepSpeedCPUAdam._next_id += 1
+            self._opt_id = DeepSpeedCPUAdam._next_id
+            self._lib.ds_adam_create(
+                self._opt_id, self.lr, self.betas[0], self.betas[1], self.eps,
+                self.weight_decay, int(self.adam_w_mode), int(self.bias_correction))
+        except Exception as e:  # no compiler: numpy fallback
+            from ..utils.logging import logger
+
+            logger.warning("cpu_adam native op unavailable (%s); numpy fallback", e)
+
+    def __del__(self):
+        lib, oid = getattr(self, "_lib", None), getattr(self, "_opt_id", None)
+        if lib is not None and oid is not None:
+            try:
+                lib.ds_adam_destroy(oid)
+            except Exception:
+                pass
+
+    @property
+    def has_native(self) -> bool:
+        return self._lib is not None
+
+    def step_flat(self, step, params, grads, exp_avg, exp_avg_sq, lr=None,
+                  bf16_out=None):
+        """In-place Adam step on flat fp32 numpy arrays. `bf16_out` (uint16
+        view) receives the round-to-nearest-even bf16 copy of the updated
+        params when given."""
+        import ctypes
+
+        import numpy as _np
+
+        lr = self.lr if lr is None else float(lr)
+        n = params.size
+        for a in (params, grads, exp_avg, exp_avg_sq):
+            assert a.dtype == _np.float32 and a.flags["C_CONTIGUOUS"]
+        if self._lib is not None:
+            fp = lambda x: x.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+            if bf16_out is not None:
+                rc = self._lib.ds_adam_step_copy_bf16(
+                    self._opt_id, int(step), lr, -1.0, -1.0, -1.0, -1.0,
+                    fp(params), fp(grads), fp(exp_avg), fp(exp_avg_sq), n,
+                    bf16_out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)))
+            else:
+                rc = self._lib.ds_adam_step(
+                    self._opt_id, int(step), lr, -1.0, -1.0, -1.0, -1.0,
+                    fp(params), fp(grads), fp(exp_avg), fp(exp_avg_sq), n)
+            if rc != 0:
+                raise RuntimeError("native cpu_adam step failed")
+            return
+        # numpy fallback (same math as FusedAdam.update)
+        b1, b2 = self.betas
+        g = grads
+        if self.weight_decay and not self.adam_w_mode:
+            g = g + self.weight_decay * params
+        exp_avg *= b1
+        exp_avg += (1.0 - b1) * g
+        exp_avg_sq *= b2
+        exp_avg_sq += (1.0 - b2) * g * g
+        if self.bias_correction:
+            bc1 = 1.0 - b1 ** step
+            bc2 = 1.0 - b2 ** step
+        else:
+            bc1 = bc2 = 1.0
+        denom = _np.sqrt(exp_avg_sq / bc2) + self.eps
+        upd = (exp_avg / bc1) / denom
+        if self.weight_decay and self.adam_w_mode:
+            upd = upd + self.weight_decay * params
+        params -= lr * upd
+        if bf16_out is not None:
+            import jax.numpy as jnp
+
+            bf16_out[:] = _np.asarray(
+                jnp.asarray(params, jnp.bfloat16)).view(_np.uint16)

@@ -1,0 +1,141 @@
+"""Python surface of the native async I/O op.
+
+Mirrors the reference's ``aio_handle`` pybind class
+(/root/reference/csrc/aio/py_lib/deepspeed_py_aio_handle.h:23-59 and
+py_ds_aio.cpp): block_size/queue_depth/single_submit/overlap_events/
+thread_count configuration, sync_pread/sync_pwrite, async_pread/async_pwrite
++ wait. Operates on numpy arrays (the host staging buffers of the swap
+machinery) instead of torch tensors.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional
+
+import numpy as np
+
+from .op_builder import AsyncIOBuilder
+
+_DEFAULT_BLOCK_SIZE = 1 << 20
+_DEFAULT_QUEUE_DEPTH = 8
+
+
+def _as_bytes_view(arr: np.ndarray) -> np.ndarray:
+    if not arr.flags["C_CONTIGUOUS"]:
+        raise ValueError("aio buffers must be C-contiguous")
+    return arr
+
+
+class AsyncIOHandle:
+    """One I/O queue: a native thread pool with per-thread kernel AIO contexts."""
+
+    def __init__(
+        self,
+        block_size: int = _DEFAULT_BLOCK_SIZE,
+        queue_depth: int = _DEFAULT_QUEUE_DEPTH,
+        single_submit: bool = False,
+        overlap_events: bool = True,
+        thread_count: int = 1,
+    ):
+        self._lib = AsyncIOBuilder().load()
+        self._h = self._lib.ds_aio_handle_new(
+            int(block_size), int(queue_depth), int(single_submit),
+            int(overlap_events), int(thread_count))
+        if not self._h:
+            raise RuntimeError("failed to create aio handle")
+
+    # -- introspection (reference: get_block_size etc.) ----------------------
+    def get_block_size(self) -> int:
+        return self._lib.ds_aio_get_block_size(self._h)
+
+    def get_queue_depth(self) -> int:
+        return self._lib.ds_aio_get_queue_depth(self._h)
+
+    def get_single_submit(self) -> bool:
+        return bool(self._lib.ds_aio_get_single_submit(self._h))
+
+    def get_overlap_events(self) -> bool:
+        return bool(self._lib.ds_aio_get_overlap_events(self._h))
+
+    def get_thread_count(self) -> int:
+        return self._lib.ds_aio_get_thread_count(self._h)
+
+    # -- synchronous ---------------------------------------------------------
+    def sync_pread(self, buffer: np.ndarray, filename: str,
+                   nbytes: Optional[int] = None) -> int:
+        buffer = _as_bytes_view(buffer)
+        n = buffer.nbytes if nbytes is None else nbytes
+        got = self._lib.ds_aio_sync_pread(
+            self._h, ctypes.c_void_p(buffer.ctypes.data), filename.encode(), n)
+        if got < 0:
+            raise IOError(f"aio read failed: {filename}")
+        return got
+
+    def sync_pwrite(self, buffer: np.ndarray, filename: str,
+                    nbytes: Optional[int] = None) -> int:
+        buffer = _as_bytes_view(buffer)
+        n = buffer.nbytes if nbytes is None else nbytes
+        got = self._lib.ds_aio_sync_pwrite(
+            self._h, ctypes.c_void_p(buffer.ctypes.data), filename.encode(), n)
+        if got < 0:
+            raise IOError(f"aio write failed: {filename}")
+        return got
+
+    # -- asynchronous (completion via wait) ----------------------------------
+    def async_pread(self, buffer: np.ndarray, filename: str,
+                    nbytes: Optional[int] = None) -> None:
+        buffer = _as_bytes_view(buffer)
+        n = buffer.nbytes if nbytes is None else nbytes
+        rc = self._lib.ds_aio_async_pread(
+            self._h, ctypes.c_void_p(buffer.ctypes.data), filename.encode(), n)
+        if rc != 0:
+            raise IOError(f"aio async read submit failed: {filename}")
+
+    def async_pwrite(self, buffer: np.ndarray, filename: str,
+                     nbytes: Optional[int] = None) -> None:
+        buffer = _as_bytes_view(buffer)
+        n = buffer.nbytes if nbytes is None else nbytes
+        rc = self._lib.ds_aio_async_pwrite(
+            self._h, ctypes.c_void_p(buffer.ctypes.data), filename.encode(), n)
+        if rc != 0:
+            raise IOError(f"aio async write submit failed: {filename}")
+
+    def wait(self) -> int:
+        """Block until all outstanding async ops complete; returns their count."""
+        n = self._lib.ds_aio_wait(self._h)
+        if n < 0:
+            raise IOError("aio request failed")
+        return n
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            self._lib.ds_aio_handle_free(h)
+            self._h = None
+
+
+_ALIGN = 512  # O_DIRECT sector alignment (matches Worker::kAlign in csrc)
+
+
+def aligned_empty(shape, dtype=np.float32) -> np.ndarray:
+    """O_DIRECT-aligned host buffer (the analog of the reference's pinned,
+    block-aligned swap buffers). The oversized base array stays alive via
+    ``arr.base``; capacity is rounded up to the sector size so kernel-AIO
+    tail blocks stay in-bounds."""
+    dtype = np.dtype(dtype)
+    count = int(np.prod(shape))
+    nbytes = count * dtype.itemsize
+    cap = (max(nbytes, 1) + _ALIGN - 1) // _ALIGN * _ALIGN
+    raw = np.empty(cap + _ALIGN, dtype=np.uint8)
+    offset = (-raw.ctypes.data) % _ALIGN
+    return raw[offset:offset + nbytes].view(dtype).reshape(shape)
+
+
+def parallel_copy(dst: np.ndarray, src: np.ndarray, threads: int = 4) -> None:
+    """GIL-free parallel memcpy (reference: deepspeed_py_copy.cpp)."""
+    if dst.nbytes != src.nbytes:
+        raise ValueError("size mismatch")
+    lib = AsyncIOBuilder().load()
+    lib.ds_aio_memcpy(ctypes.c_void_p(dst.ctypes.data),
+                      ctypes.c_void_p(src.ctypes.data), dst.nbytes, threads)

@@ -138,6 +138,31 @@ class Engine:
         self.lr_scheduler = lr_scheduler or self._configure_lr_scheduler()
         self._client_lr = _optimizer_base_lr(self.optimizer, config)
 
+        # ZeRO-Offload / ZeRO-Infinity: optimizer state leaves the device
+        # (reference stage2.py cpu_offload / stage3 offload_optimizer).
+        self._offload = None
+        off_cfg = config.zero_config.offload_optimizer
+        if off_cfg.enabled:
+            if jax.process_count() > 1:
+                raise NotImplementedError(
+                    "offload_optimizer is single-process for now: the host "
+                    "step fetches globally-sharded grads, which is not "
+                    "addressable across processes yet"
+                )
+            if not isinstance(self.optimizer, DeepSpeedCPUAdam):
+                # host steps always run on the cpu_adam kernel, whatever the
+                # configured optimizer name (reference forces DeepSpeedCPUAdam
+                # under cpu_offload, engine.py:713-724)
+                self.optimizer = DeepSpeedCPUAdam(
+                    lr=getattr(self.optimizer, "lr", 1e-3),
+                    betas=getattr(self.optimizer, "betas", (0.9, 0.999)),
+                    eps=getattr(self.optimizer, "eps", 1e-8),
+                    weight_decay=getattr(self.optimizer, "weight_decay", 0.0),
+                    adam_w_mode=getattr(self.optimizer, "adam_w_mode", True),
+                    bias_correction=getattr(self.optimizer, "bias_correction", True),
+                )
+            self._offload_cfg = off_cfg
+
         # ---- sharding specs ----
         tp_specs = param_specs
         if tp_specs is None:
@@ -250,6 +275,31 @@ class Engine:
             return jax.tree.map(leaf, tree, specs)
 
         params_c = place(params, self.param_specs, self._compute_dtype)
+
+        if getattr(self, "_offload_cfg", None) is not None:
+            # master + moments live off-device; device state is params-only
+            from .offload.offload_optimizer import HostOffloadOptimizer
+
+            self._offload = HostOffloadOptimizer(
+                params,
+                self.optimizer,
+                device=self._offload_cfg.device,
+                compute_dtype=np.dtype(self._compute_dtype),
+                aio_config=self._config.aio_config,
+                swap_folder=self._offload_cfg.nvme_path,
+                pipeline=bool(
+                    self._offload_cfg.pipeline_read or self._offload_cfg.pipeline_write
+                ),
+            )
+            return EngineState(
+                step=jnp.zeros((), jnp.int32),
+                params=params_c,
+                master=None,
+                opt_state=(),
+                scaler=self._loss_scaler.init(),
+                skipped=jnp.zeros((), jnp.int32),
+            )
+
         master = None if fp32 else place(params, self.master_specs, jnp.float32)
         opt_src = params_c if fp32 else master
         opt_state = jax.jit(
@@ -402,6 +452,37 @@ class Engine:
 
         return self._get_compiled("apply_update", build)
 
+    def _batch_grads(self, state, batch, rng, gas):
+        """Traced: scan over gas microbatches; returns (mean loss, summed
+        scaled grads)."""
+        scale = state.scaler.loss_scale
+        if gas == 1:
+            loss, grads = self._micro_grads(state.params, batch, rng, scale)
+            grads = partition.constrain(grads, self.grad_specs, self.mesh)
+            return loss, grads
+
+        def resh(x):
+            return jnp.reshape(x, (gas, x.shape[0] // gas) + x.shape[1:])
+
+        batch_g = jax.tree.map(resh, batch)
+        zero_g = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
+        zero_g = partition.constrain(zero_g, self.grad_specs, self.mesh)
+
+        def body(carry, mb):
+            acc, loss_sum, i = carry
+            loss, grads = self._micro_grads(
+                state.params, mb, jax.random.fold_in(rng, i), scale
+            )
+            grads = partition.constrain(grads, self.grad_specs, self.mesh)
+            acc = jax.tree.map(jnp.add, acc, grads)
+            acc = partition.constrain(acc, self.grad_specs, self.mesh)
+            return (acc, loss_sum + loss, i + 1), None
+
+        (grads, loss_sum, _), _ = jax.lax.scan(
+            body, (zero_g, jnp.float32(0.0), jnp.int32(0)), batch_g
+        )
+        return loss_sum / gas, grads
+
     def _train_batch_fn(self):
         """Fully fused jitted step: scan over gas microbatches + update."""
 
@@ -409,45 +490,91 @@ class Engine:
             gas = self.gradient_accumulation_steps()
 
             def fn(state, batch, lr, rng):
-                scale = state.scaler.loss_scale
-
-                if gas == 1:
-                    # no accumulator round-trip on the hot path
-                    loss, grads = self._micro_grads(state.params, batch, rng, scale)
-                    grads = partition.constrain(grads, self.grad_specs, self.mesh)
-                    new_state, metrics = self._apply_update_body(state, grads, lr, 1)
-                    metrics["loss"] = loss
-                    return new_state, metrics
-
-                def resh(x):
-                    return jnp.reshape(x, (gas, x.shape[0] // gas) + x.shape[1:])
-
-                batch_g = jax.tree.map(resh, batch)
-                zero_g = jax.tree.map(
-                    lambda p: jnp.zeros(p.shape, jnp.float32), state.params
-                )
-                zero_g = partition.constrain(zero_g, self.grad_specs, self.mesh)
-
-                def body(carry, mb):
-                    acc, loss_sum, i = carry
-                    loss, grads = self._micro_grads(
-                        state.params, mb, jax.random.fold_in(rng, i), scale
-                    )
-                    grads = partition.constrain(grads, self.grad_specs, self.mesh)
-                    acc = jax.tree.map(jnp.add, acc, grads)
-                    acc = partition.constrain(acc, self.grad_specs, self.mesh)
-                    return (acc, loss_sum + loss, i + 1), None
-
-                (grads, loss_sum, _), _ = jax.lax.scan(
-                    body, (zero_g, jnp.float32(0.0), jnp.int32(0)), batch_g
-                )
+                loss, grads = self._batch_grads(state, batch, rng, gas)
                 new_state, metrics = self._apply_update_body(state, grads, lr, gas)
-                metrics["loss"] = loss_sum / gas
+                metrics["loss"] = loss
                 return new_state, metrics
 
             return jax.jit(fn, donate_argnums=(0,))
 
         return self._get_compiled("train_batch", build)
+
+    def _offload_grads_fn(self):
+        """Device half of the offloaded step: grads unscaled + clipped on
+        device (cheap, sharded), fetched once by the host Adam."""
+
+        def build():
+            gas = self.gradient_accumulation_steps()
+            clip = float(self._config.gradient_clipping or 0.0)
+
+            def fn(state, batch, rng):
+                loss, grads = self._batch_grads(state, batch, rng, gas)
+                grads, gnorm, finite = self._postprocess_grads(
+                    state, grads, jnp.float32(gas), clip
+                )
+                return loss, grads, gnorm, finite
+
+            return jax.jit(fn)
+
+        return self._get_compiled("offload_grads", build)
+
+    @staticmethod
+    def _postprocess_grads(state, grads, gas, clip):
+        """Traced: unscale by loss_scale*gas, global-norm clip, overflow flag."""
+        inv = 1.0 / (state.scaler.loss_scale * gas)
+        grads = jax.tree.map(lambda g: g * inv, grads)
+        flat = jax.tree.leaves(grads)
+        finite = jnp.all(jnp.stack([jnp.all(jnp.isfinite(g)) for g in flat]))
+        gnorm = jnp.sqrt(jnp.sum(jnp.stack([jnp.sum(g**2) for g in flat])))
+        if clip > 0:
+            coef = jnp.minimum(1.0, clip / (gnorm + 1e-6))
+            grads = jax.tree.map(lambda g: g * coef, grads)
+        grads = jax.tree.map(jnp.nan_to_num, grads)
+        return grads, gnorm, finite
+
+    def _offload_post_fn(self):
+        """jitted (state, grads, gas) -> (grads, gnorm, finite) for the
+        imperative forward/backward/step path under offload."""
+
+        def build():
+            clip = float(self._config.gradient_clipping or 0.0)
+
+            def fn(state, grads, gas):
+                return self._postprocess_grads(state, grads, gas, clip)
+
+            return jax.jit(fn)
+
+        return self._get_compiled("offload_post", build)
+
+    def _offload_apply(self, grads_device, gnorm, finite, loss):
+        """Host half of the offloaded step: CPU Adam on host state + one
+        device_put of the fresh params."""
+        overflow = not bool(jax.device_get(finite))
+        state = self.state
+        if overflow:
+            state = state._replace(skipped=state.skipped + 1)
+        else:
+            grads_np = jax.device_get(grads_device)
+            new_params_np = self._offload.step(grads_np, lr=self._current_lr())
+            params = jax.tree.map(
+                lambda x, s: jax.device_put(
+                    np.asarray(x), NamedSharding(self.mesh, s)
+                ),
+                new_params_np,
+                self.param_specs,
+            )
+            state = state._replace(params=params, step=state.step + 1)
+        metrics = {
+            "overflow": jnp.asarray(overflow),
+            "grad_norm": gnorm,
+            "loss_scale": state.scaler.loss_scale,
+            "loss": loss,
+        }
+        state = state._replace(
+            scaler=self._loss_scaler.update(state.scaler, jnp.asarray(overflow))
+        )
+        self.state = state
+        return metrics
 
     def _apply_update_body(self, state, grads, lr, gas):
         """Non-jitted body shared between the fused and imperative paths."""
@@ -457,18 +584,8 @@ class Engine:
         scaler = self._loss_scaler
         fp32 = self._compute_dtype == jnp.float32
 
-        inv = 1.0 / (state.scaler.loss_scale * gas)
-        grads = jax.tree.map(lambda g: g * inv, grads)
-        flat = jax.tree.leaves(grads)
-        finite = jnp.all(jnp.stack([jnp.all(jnp.isfinite(g)) for g in flat]))
+        grads, gnorm, finite = self._postprocess_grads(state, grads, gas, clip)
         overflow = ~finite
-        gnorm = jnp.sqrt(
-            jnp.sum(jnp.stack([jnp.sum(g.astype(jnp.float32) ** 2) for g in flat]))
-        )
-        if clip > 0:
-            coef = jnp.minimum(1.0, clip / (gnorm + 1e-6))
-            grads = jax.tree.map(lambda g: g * coef, grads)
-        grads = jax.tree.map(jnp.nan_to_num, grads)
 
         target = state.params if fp32 else state.master
         new_target, new_opt = opt.update(grads, state.opt_state, target, lr)
@@ -539,12 +656,18 @@ class Engine:
         microbatch's backward())."""
         gas = self.gradient_accumulation_steps()
         if self._acc_count >= gas:
-            lr = jnp.float32(self._current_lr())
-            # the imperative path banked unscaled-by-gas grads; scale in fn
-            new_state, metrics = self._apply_update_fn()(
-                self.state, self._grad_acc, lr, jnp.float32(self._acc_count)
-            )
-            self.state = new_state
+            if self._offload is not None:
+                grads, gnorm, finite = self._offload_post_fn()(
+                    self.state, self._grad_acc, jnp.float32(self._acc_count)
+                )
+                metrics = self._offload_apply(grads, gnorm, finite, None)
+            else:
+                lr = jnp.float32(self._current_lr())
+                # the imperative path banked unscaled-by-gas grads; scale in fn
+                new_state, metrics = self._apply_update_fn()(
+                    self.state, self._grad_acc, lr, jnp.float32(self._acc_count)
+                )
+                self.state = new_state
             self._grad_acc = None
             self._acc_count = 0
             self._after_optimizer_step(metrics)
@@ -583,8 +706,14 @@ class Engine:
         rng, self.rng = _split(self.rng)
         lr = jnp.float32(self._current_lr())
         self.tput_timer.start()
-        new_state, metrics = self._train_batch_fn()(self.state, batch, lr, rng)
-        self.state = new_state
+        if self._offload is not None:
+            loss, grads, gnorm, finite = self._offload_grads_fn()(
+                self.state, batch, rng
+            )
+            metrics = self._offload_apply(grads, gnorm, finite, loss)
+        else:
+            new_state, metrics = self._train_batch_fn()(self.state, batch, lr, rng)
+            self.state = new_state
         self.micro_steps += self.gradient_accumulation_steps()
         self._after_optimizer_step(metrics)
         self.tput_timer.stop(global_step=True, sync_with=metrics["loss"])
@@ -652,6 +781,9 @@ class Engine:
             "step": int(jax.device_get(state.step)),
             "zero_stage": self.zero_stage,
         }
+        if self._offload is not None:
+            # host/NVMe state is the source of truth under offload
+            optim_states["offload"] = self._offload.state_dict()
         ck.save(optim_state_filename(), optim_states)
         if save_latest and jax.process_index() == 0:
             write_latest(save_dir, tag)
@@ -696,7 +828,21 @@ class Engine:
             optim_state_filename()
         ):
             optim_states = ck.load(optim_state_filename())
-            if state.master is not None and optim_states.get("master"):
+            if self._offload is not None and optim_states.get("offload"):
+                self._offload.load_state_dict(optim_states["offload"])
+                # refresh device params from the restored master copy
+                fresh = self._offload.current_params()
+                state = state._replace(
+                    params=jax.tree.map(
+                        lambda x, s: jax.device_put(
+                            np.asarray(x), NamedSharding(mesh, s)
+                        ),
+                        fresh,
+                        self.param_specs,
+                    ),
+                    step=jnp.asarray(optim_states["step"], jnp.int32),
+                )
+            elif state.master is not None and optim_states.get("master"):
                 master = jax.tree.map(
                     lambda x, s: jax.device_put(
                         jnp.asarray(x, jnp.float32), NamedSharding(mesh, s)

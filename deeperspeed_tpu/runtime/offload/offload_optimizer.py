@@ -1,0 +1,187 @@
+"""Host/NVMe-offloaded optimizer execution (ZeRO-Offload / ZeRO-Infinity).
+
+Capability parity with the reference's two offload tiers:
+  * ``device: cpu``  — fp32 master params + Adam moments live in host RAM and
+    the step runs on the AVX cpu_adam kernel with fused low-precision
+    copy-back (reference runtime/zero/stage2.py:132-136,1450-1461 +
+    csrc/adam/cpu_adam.cpp);
+  * ``device: nvme`` — master + moments live in per-leaf swap files and are
+    streamed through the aio op around each leaf's step, optionally
+    double-buffered (reference runtime/swap_tensor/partitioned_optimizer_
+    swapper.py:27, pipelined_optimizer_swapper.py:60).
+
+The TPU redesign: instead of backward hooks copying grad buckets to pinned
+memory, the jitted step produces the full (unscaled, clipped) grad pytree;
+the engine fetches it once per optimizer step, this class updates host state
+and returns the bf16 (or fp32) param pytree for a single device_put. TPU
+compute overlaps the *next* step's forward; within the step, NVMe reads/
+writes overlap the per-leaf CPU Adam via the pipelined swapper.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Dict, List, Optional
+
+import jax
+import numpy as np
+
+try:
+    import ml_dtypes
+
+    _BF16 = np.dtype(ml_dtypes.bfloat16)
+except ImportError:  # pragma: no cover - ml_dtypes ships with jax
+    _BF16 = None
+
+from ...ops.adam import DeepSpeedCPUAdam
+from ...ops.aio import aligned_empty
+from ...utils.logging import log_dist
+from .aio_config import AioConfig
+from .swapper import PartitionedOptimizerSwapper, PipelinedOptimizerSwapper
+
+
+def _leaf_name(path) -> str:
+    return "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+
+
+class HostOffloadOptimizer:
+    """Owns the fp32 master copy + Adam moments off-device and performs the
+    optimizer step on the host CPU."""
+
+    def __init__(
+        self,
+        params,  # device (or host) pytree giving shapes/structure
+        opt: DeepSpeedCPUAdam,
+        device: str = "cpu",
+        compute_dtype=np.float32,
+        aio_config: Optional[AioConfig] = None,
+        swap_folder: Optional[str] = None,
+        pipeline: bool = False,
+    ):
+        assert device in ("cpu", "nvme")
+        self.opt = opt
+        self.device = device
+        self.step_count = 0
+        self.out_dtype = np.dtype(compute_dtype)
+        # native fused copy-back emits bf16; other dtypes cast from master
+        self._bf16 = _BF16 is not None and self.out_dtype == _BF16
+
+        paths_leaves, self.treedef = jax.tree_util.tree_flatten_with_path(params)
+        self.names: List[str] = [_leaf_name(p) for p, _ in paths_leaves]
+        self.shapes = [tuple(l.shape) for _, l in paths_leaves]
+
+        host_leaves = [np.asarray(jax.device_get(l), np.float32) for _, l in paths_leaves]
+
+        self.swapper = None
+        self._ram: Dict[str, Dict[str, np.ndarray]] = {}
+        if device == "cpu":
+            for name, leaf in zip(self.names, host_leaves):
+                flat = leaf.ravel()
+                states = {
+                    "master": aligned_empty(flat.shape, np.float32),
+                    "exp_avg": aligned_empty(flat.shape, np.float32),
+                    "exp_avg_sq": aligned_empty(flat.shape, np.float32),
+                }
+                np.copyto(states["master"], flat)
+                states["exp_avg"][:] = 0
+                states["exp_avg_sq"][:] = 0
+                self._ram[name] = states
+        else:
+            aio_config = aio_config or AioConfig()
+            swap_folder = swap_folder or os.path.join(
+                tempfile.gettempdir(), "ds_tpu_optimizer_swap")
+            cls = PipelinedOptimizerSwapper if pipeline else PartitionedOptimizerSwapper
+            self.swapper = cls(aio_config, swap_folder)
+            for name, leaf in zip(self.names, host_leaves):
+                flat = np.ascontiguousarray(leaf.ravel())
+                self.swapper.register_leaf(name, {
+                    "master": flat,
+                    "exp_avg": np.zeros_like(flat),
+                    "exp_avg_sq": np.zeros_like(flat),
+                })
+            log_dist(f"optimizer state swapped to NVMe at {swap_folder} "
+                     f"({len(self.names)} leaves)", ranks=[0])
+        del host_leaves
+
+    # ------------------------------------------------------------------ #
+
+    def step(self, grads, lr: float):
+        """One optimizer step. `grads` is a pytree of fp32 numpy arrays
+        (already unscaled + clipped on device). Returns the updated param
+        pytree as numpy arrays in the compute dtype, ready for device_put."""
+        self.step_count += 1
+        flat_grads = [np.asarray(g, np.float32).ravel()
+                      for g in self.treedef.flatten_up_to(grads)]
+        out: Dict[str, np.ndarray] = {}
+
+        index = {n: i for i, n in enumerate(self.names)}
+
+        def step_leaf(name: str, states: Dict[str, np.ndarray]):
+            i = index[name]
+            g = flat_grads[i]
+            bf16 = np.empty(g.shape, np.uint16) if self._bf16 else None
+            self.opt.step_flat(
+                self.step_count, states["master"], g,
+                states["exp_avg"], states["exp_avg_sq"], lr=lr, bf16_out=bf16)
+            if self._bf16:
+                out[name] = bf16.view(_BF16).reshape(self.shapes[i])
+            elif self.out_dtype == np.float32:
+                out[name] = states["master"].reshape(self.shapes[i]).copy()
+            else:  # e.g. fp16 compute: cast from the fp32 master
+                out[name] = states["master"].reshape(self.shapes[i]).astype(
+                    self.out_dtype)
+
+        if self.device == "cpu":
+            for name in self.names:
+                step_leaf(name, self._ram[name])
+        else:
+            self.swapper.for_each_leaf(self.names, step_leaf)
+        return self.treedef.unflatten([out[n] for n in self.names])
+
+    # ------------------------------------------------------------------ #
+    # checkpoint surface (consumed by Engine.save/load_checkpoint)
+    # ------------------------------------------------------------------ #
+
+    def _all_states(self) -> Dict[str, Dict[str, np.ndarray]]:
+        if self.device == "cpu":
+            return {n: {k: v.copy() for k, v in s.items()}
+                    for n, s in self._ram.items()}
+        states = {}
+        for name in self.names:
+            buf = self.swapper.swap_in(name, async_op=False)
+            states[name] = {k: v.copy()
+                            for k, v in self.swapper.unpack(name, buf).items()}
+        return states
+
+    def state_dict(self) -> dict:
+        return {
+            "step": self.step_count,
+            "states": self._all_states(),
+            "device": self.device,
+        }
+
+    def load_state_dict(self, sd: dict):
+        self.step_count = int(sd["step"])
+        for name in self.names:
+            src = sd["states"][name]
+            if self.device == "cpu":
+                for k in ("master", "exp_avg", "exp_avg_sq"):
+                    np.copyto(self._ram[name][k], np.asarray(src[k]))
+            else:
+                self.swapper.swap_out(
+                    name,
+                    {k: np.ascontiguousarray(np.asarray(src[k]))
+                     for k in ("master", "exp_avg", "exp_avg_sq")},
+                    async_op=False)
+
+    def current_params(self):
+        """Materialize the compute-dtype param pytree from the master copy
+        (used on checkpoint load to refresh device params)."""
+        outs = []
+        states = self._all_states() if self.device == "nvme" else self._ram
+        for i, name in enumerate(self.names):
+            m = states[name]["master"].reshape(self.shapes[i])
+            outs.append(m.copy() if self.out_dtype == np.float32
+                        else m.astype(self.out_dtype))
+        return self.treedef.unflatten(outs)

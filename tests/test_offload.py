@@ -1,0 +1,362 @@
+"""Native-op + ZeRO-Offload/Infinity tests.
+
+Parity targets: reference tests/unit/test_cpu_adam.py (native Adam vs
+reference math), the aio op's csrc tests (round-trip + async), and the
+cpu_offload configs of tests/unit/test_fp16.py (offloaded training matches
+on-device training).
+"""
+
+import ctypes
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deeperspeed_tpu as ds
+from deeperspeed_tpu.ops.adam import DeepSpeedCPUAdam, FusedAdam
+from deeperspeed_tpu.ops.aio import AsyncIOHandle, aligned_empty, parallel_copy
+from deeperspeed_tpu.ops.op_builder import ALL_OPS, AsyncIOBuilder, CPUAdamBuilder
+from deeperspeed_tpu.runtime.offload.aio_config import AioConfig
+from deeperspeed_tpu.runtime.offload.swapper import (
+    PartitionedOptimizerSwapper,
+    PipelinedOptimizerSwapper,
+    SwapBuffer,
+    SwapBufferPool,
+)
+from tests.simple_model import base_config, init_linear_stack, linear_stack_loss
+
+DIMS = [16, 32, 16]
+
+needs_native = pytest.mark.skipif(
+    not AsyncIOBuilder().is_compatible(), reason="native toolchain unavailable"
+)
+
+
+# --------------------------------------------------------------------------- #
+# aio op
+# --------------------------------------------------------------------------- #
+
+
+@needs_native
+class TestAsyncIO:
+    def test_handle_config(self):
+        h = AsyncIOHandle(block_size=1 << 16, queue_depth=4, single_submit=True,
+                          overlap_events=False, thread_count=3)
+        assert h.get_block_size() == 1 << 16
+        assert h.get_queue_depth() == 4
+        assert h.get_single_submit() is True
+        assert h.get_overlap_events() is False
+        assert h.get_thread_count() == 3
+
+    def test_sync_round_trip_aligned(self, tmp_path):
+        h = AsyncIOHandle(block_size=1 << 15, queue_depth=4, thread_count=2)
+        src = aligned_empty((1 << 16,), np.float32)
+        src[:] = np.random.default_rng(0).standard_normal(src.size)
+        path = str(tmp_path / "t.swp")
+        assert h.sync_pwrite(src, path) == src.nbytes
+        assert os.path.getsize(path) == src.nbytes
+        dst = aligned_empty((1 << 16,), np.float32)
+        assert h.sync_pread(dst, path) == src.nbytes
+        np.testing.assert_array_equal(src, dst)
+
+    def test_sync_round_trip_unaligned(self, tmp_path):
+        h = AsyncIOHandle()
+        src = np.random.default_rng(1).standard_normal(1001).astype(np.float32)
+        path = str(tmp_path / "odd.swp")
+        h.sync_pwrite(src, path)
+        assert os.path.getsize(path) == src.nbytes
+        dst = np.empty_like(src)
+        h.sync_pread(dst, path)
+        np.testing.assert_array_equal(src, dst)
+
+    def test_async_round_trip(self, tmp_path):
+        h = AsyncIOHandle(thread_count=2)
+        srcs, paths = [], []
+        for i in range(4):
+            s = aligned_empty((2048,), np.float32)
+            s[:] = i + np.arange(2048)
+            p = str(tmp_path / f"a{i}.swp")
+            h.async_pwrite(s, p)
+            srcs.append(s)
+            paths.append(p)
+        assert h.wait() == 4
+        dsts = [aligned_empty((2048,), np.float32) for _ in range(4)]
+        for d, p in zip(dsts, paths):
+            h.async_pread(d, p)
+        assert h.wait() == 4
+        for s, d in zip(srcs, dsts):
+            np.testing.assert_array_equal(s, d)
+
+    def test_submit_strategies(self, tmp_path):
+        src = aligned_empty((1 << 16,), np.float32)
+        src[:] = np.random.default_rng(2).standard_normal(src.size)
+        path = str(tmp_path / "s.swp")
+        AsyncIOHandle().sync_pwrite(src, path)
+        for ss in (False, True):
+            for ov in (False, True):
+                h = AsyncIOHandle(block_size=1 << 14, queue_depth=3,
+                                  single_submit=ss, overlap_events=ov,
+                                  thread_count=2)
+                d = aligned_empty((1 << 16,), np.float32)
+                assert h.sync_pread(d, path) == src.nbytes
+                np.testing.assert_array_equal(src, d)
+
+    def test_parallel_copy(self):
+        a = np.random.default_rng(3).standard_normal(1 << 20).astype(np.float32)
+        b = np.empty_like(a)
+        parallel_copy(b, a, threads=4)
+        np.testing.assert_array_equal(a, b)
+
+    def test_missing_file_raises(self, tmp_path):
+        h = AsyncIOHandle()
+        with pytest.raises(IOError):
+            h.sync_pread(np.empty(16, np.float32), str(tmp_path / "nope"))
+
+
+# --------------------------------------------------------------------------- #
+# swap buffers + optimizer swappers
+# --------------------------------------------------------------------------- #
+
+
+@needs_native
+class TestSwapBuffers:
+    def test_swap_buffer_packing(self):
+        buf = SwapBuffer(1 << 16)
+        a = buf.insert("a", np.arange(100, dtype=np.float32))
+        b = buf.insert("b", np.arange(7, dtype=np.int64))
+        np.testing.assert_array_equal(buf.get("a"), np.arange(100, dtype=np.float32))
+        np.testing.assert_array_equal(buf.get("b"), np.arange(7, dtype=np.int64))
+        assert a.ctypes.data % 512 == 0 and b.ctypes.data % 512 == 0
+        buf.reset()
+        assert buf.offset == 0 and not buf.tensors
+
+    def test_swap_buffer_full(self):
+        buf = SwapBuffer(1024)
+        buf.allocate("x", (128,), np.float32)
+        with pytest.raises(RuntimeError):
+            buf.allocate("y", (1024,), np.float32)
+
+    def test_pool(self):
+        pool = SwapBufferPool(2, 4096)
+        b1, b2 = pool.acquire(), pool.acquire()
+        assert pool.acquire() is None
+        pool.release(b1)
+        assert pool.acquire() is b1
+        assert b2 in pool.buffers
+
+    @pytest.mark.parametrize("cls", [PartitionedOptimizerSwapper,
+                                     PipelinedOptimizerSwapper])
+    def test_optimizer_swapper_round_trip(self, cls, tmp_path):
+        sw = cls(AioConfig(), str(tmp_path))
+        rng = np.random.default_rng(0)
+        ref = {}
+        for leaf in ("l0/w", "l0/b", "l1/w"):
+            states = {
+                "master": rng.standard_normal(333).astype(np.float32),
+                "exp_avg": rng.standard_normal(333).astype(np.float32),
+                "exp_avg_sq": rng.standard_normal(333).astype(np.float32),
+            }
+            sw.register_leaf(leaf, states)
+            ref[leaf] = {k: v.copy() for k, v in states.items()}
+
+        seen = {}
+
+        def bump(leaf, states):
+            seen[leaf] = {k: v.copy() for k, v in states.items()}
+            states["master"] += 1.0
+
+        sw.for_each_leaf(sw.leaf_names(), bump)
+        for leaf in ref:
+            for k in ref[leaf]:
+                np.testing.assert_allclose(seen[leaf][k], ref[leaf][k])
+        # second sweep observes the +1 from the first
+        sw.for_each_leaf(sw.leaf_names(), bump)
+        for leaf in ref:
+            np.testing.assert_allclose(
+                seen[leaf]["master"], ref[leaf]["master"] + 1.0)
+
+
+# --------------------------------------------------------------------------- #
+# cpu adam op
+# --------------------------------------------------------------------------- #
+
+
+class TestCPUAdam:
+    def test_matches_fused_adam(self):
+        """Host AVX step == device FusedAdam step over multiple iterations
+        (reference tests/unit/test_cpu_adam.py checks vs torch AdamW)."""
+        n = 4099
+        rng = np.random.default_rng(0)
+        p0 = rng.standard_normal(n).astype(np.float32)
+        fused = FusedAdam(lr=1e-2, weight_decay=0.01)
+        cpu = DeepSpeedCPUAdam(lr=1e-2, weight_decay=0.01)
+
+        dev_p = jnp.asarray(p0)
+        dev_state = fused.init(dev_p)
+        host_p = p0.copy()
+        host_m = np.zeros(n, np.float32)
+        host_v = np.zeros(n, np.float32)
+
+        for step in range(1, 6):
+            g = rng.standard_normal(n).astype(np.float32)
+            dev_p, dev_state = fused.update(jnp.asarray(g), dev_state, dev_p)
+            cpu.step_flat(step, host_p, g, host_m, host_v)
+            np.testing.assert_allclose(host_p, np.asarray(dev_p), rtol=2e-5,
+                                       atol=2e-6)
+
+    def test_bf16_copyback_matches_xla_cast(self):
+        n = 1024
+        rng = np.random.default_rng(1)
+        p = rng.standard_normal(n).astype(np.float32)
+        g = rng.standard_normal(n).astype(np.float32)
+        m = np.zeros(n, np.float32)
+        v = np.zeros(n, np.float32)
+        cpu = DeepSpeedCPUAdam(lr=1e-3)
+        bf = np.empty(n, np.uint16)
+        cpu.step_flat(1, p, g, m, v, bf16_out=bf)
+        ref = np.asarray(jnp.asarray(p, jnp.bfloat16)).view(np.uint16)
+        np.testing.assert_array_equal(bf, ref)
+
+    def test_no_bias_correction(self):
+        n = 513
+        rng = np.random.default_rng(4)
+        p0 = rng.standard_normal(n).astype(np.float32)
+        g = rng.standard_normal(n).astype(np.float32)
+        fused = FusedAdam(lr=1e-2, bias_correction=False)
+        cpu = DeepSpeedCPUAdam(lr=1e-2, bias_correction=False)
+        dev_p, dev_state = fused.update(jnp.asarray(g), fused.init(jnp.asarray(p0)),
+                                        jnp.asarray(p0))
+        host_p = p0.copy()
+        m = np.zeros(n, np.float32)
+        v = np.zeros(n, np.float32)
+        cpu.step_flat(1, host_p, g, m, v)
+        np.testing.assert_allclose(host_p, np.asarray(dev_p), rtol=2e-5, atol=2e-6)
+
+    def test_plain_adam_l2_mode(self):
+        """adam_w_mode=False folds weight decay into the gradient."""
+        n = 257
+        rng = np.random.default_rng(2)
+        p = rng.standard_normal(n).astype(np.float32)
+        g = rng.standard_normal(n).astype(np.float32)
+        m = np.zeros(n, np.float32)
+        v = np.zeros(n, np.float32)
+        opt = DeepSpeedCPUAdam(lr=1e-2, weight_decay=0.1, adam_w_mode=False)
+        p_in = p.copy()
+        opt.step_flat(1, p, g, m, v)
+        geff = g + 0.1 * p_in
+        denom = np.sqrt(((1 - 0.999) * geff**2) / (1 - 0.999)) + opt.eps
+        expect = p_in - 1e-2 * (((1 - 0.9) * geff) / (1 - 0.9)) / denom
+        np.testing.assert_allclose(p, expect, rtol=2e-5, atol=2e-6)
+
+    @pytest.mark.skipif(not CPUAdamBuilder().is_compatible(),
+                        reason="no native toolchain")
+    def test_native_lifecycle(self):
+        lib = CPUAdamBuilder().load()
+        assert lib.ds_adam_simd_width().decode() in ("avx512", "avx2", "scalar")
+        assert lib.ds_adam_create(999, 1e-3, 0.9, 0.999, 1e-8, 0.0, 1, 1) == 0
+        assert lib.ds_adam_destroy(999) == 0
+        assert lib.ds_adam_destroy(999) == -1  # already gone
+        # stepping an unknown id fails cleanly
+        z = np.zeros(8, np.float32)
+        fp = lambda x: x.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+        assert lib.ds_adam_step(12345, 1, 1e-3, -1, -1, -1, -1,
+                                fp(z), fp(z), fp(z), fp(z), 8) == -1
+
+
+# --------------------------------------------------------------------------- #
+# engine integration: offloaded training
+# --------------------------------------------------------------------------- #
+
+
+def _make_engine(offload_device=None, tmp_path=None, precision=None, gas=1,
+                 pipeline=False):
+    params = init_linear_stack(jax.random.PRNGKey(0), DIMS)
+    extra = {}
+    if offload_device:
+        off = {"device": offload_device}
+        if offload_device == "nvme":
+            off["nvme_path"] = str(tmp_path / "swap")
+            off["pipeline_read"] = pipeline
+        extra["zero_optimization"] = {"stage": 2, "offload_optimizer": off}
+    cfg = base_config(micro_batch=4, gas=gas, lr=1e-2, precision=precision,
+                      **extra)
+    if offload_device:
+        cfg["zero_optimization"]["stage"] = 2
+    engine, _, _, _ = ds.initialize(
+        model=linear_stack_loss, model_parameters=params, config=cfg
+    )
+    return engine
+
+
+def _batch(engine, n_micro=1, seed=0):
+    rng = np.random.default_rng(seed)
+    size = engine.train_micro_batch_size_per_gpu() * engine.data_parallel_size * n_micro
+    x = rng.normal(size=(size, DIMS[0])).astype(np.float32)
+    w = np.linspace(-1, 1, DIMS[0] * DIMS[-1]).reshape(DIMS[0], DIMS[-1]).astype(np.float32)
+    return x, x @ w
+
+
+class TestOffloadedEngine:
+    @pytest.mark.parametrize("device", ["cpu", "nvme"])
+    def test_matches_on_device_training(self, device, tmp_path):
+        base = _make_engine()
+        off = _make_engine(offload_device=device, tmp_path=tmp_path)
+        for i in range(5):
+            b = _batch(base, seed=i)
+            l0 = float(base.train_batch(b))
+            l1 = float(off.train_batch(b))
+            assert abs(l0 - l1) < 1e-4, f"step {i}: {l0} vs {l1}"
+
+    def test_nvme_pipelined(self, tmp_path):
+        base = _make_engine()
+        off = _make_engine(offload_device="nvme", tmp_path=tmp_path, pipeline=True)
+        for i in range(3):
+            b = _batch(base, seed=i)
+            l0 = float(base.train_batch(b))
+            l1 = float(off.train_batch(b))
+            assert abs(l0 - l1) < 1e-4
+
+    def test_fp16_offload_keeps_param_dtype(self):
+        off = _make_engine(offload_device="cpu", precision="fp16")
+        off.train_batch(_batch(off, seed=0))
+        leaf = jax.tree.leaves(off.state.params)[0]
+        assert leaf.dtype == jnp.float16
+
+    def test_bf16_offload_trains(self, tmp_path):
+        off = _make_engine(offload_device="cpu", precision="bf16")
+        losses = [float(off.train_batch(_batch(off, seed=i))) for i in range(8)]
+        assert losses[-1] < losses[0]
+
+    def test_imperative_api_offload(self):
+        off = _make_engine(offload_device="cpu", gas=2)
+        ref = _make_engine(gas=2)
+        for i in range(3):
+            for m in range(2):
+                b = _batch(off, seed=10 * i + m)
+                l1 = off.forward(b)
+                off.backward(l1)
+                off.step()
+                l0 = ref.forward(b)
+                ref.backward(l0)
+                ref.step()
+            assert abs(float(l0) - float(l1)) < 1e-4
+
+    def test_checkpoint_round_trip(self, tmp_path):
+        off = _make_engine(offload_device="cpu")
+        for i in range(3):
+            off.train_batch(_batch(off, seed=i))
+        off.save_checkpoint(str(tmp_path / "ck"), tag="t1")
+
+        fresh = _make_engine(offload_device="cpu")
+        fresh.load_checkpoint(str(tmp_path / "ck"), tag="t1")
+        assert fresh._offload.step_count == off._offload.step_count
+        # both continue identically
+        b = _batch(off, seed=99)
+        np.testing.assert_allclose(
+            float(off.train_batch(b)), float(fresh.train_batch(b)), rtol=1e-6)
+
+    def test_ds_report_lists_native_ops(self, capsys):
+        for name, builder in ALL_OPS.items():
+            assert isinstance(builder.compatibility_message(), str)
