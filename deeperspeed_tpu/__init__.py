@@ -21,6 +21,7 @@ from .parallel.topology import (
     build_mesh,
 )
 from .utils import logger, log_dist
+from .utils.distributed import init_distributed
 
 
 def add_config_arguments(parser):

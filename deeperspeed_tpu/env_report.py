@@ -1,0 +1,107 @@
+"""Environment / op-compatibility report: the ``ds_report`` CLI.
+
+Analog of reference deepspeed/env_report.py (:23 op report, :103 main):
+prints a matrix of native ops (installed? compatible?) plus the JAX/TPU
+environment, instead of torch/CUDA versions.
+
+Run as ``python -m deeperspeed_tpu.env_report``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+GREEN = "\033[92m"
+RED = "\033[91m"
+YELLOW = "\033[93m"
+END = "\033[0m"
+OKAY = f"{GREEN}[OKAY]{END}"
+WARNING = f"{YELLOW}[WARNING]{END}"
+NO = f"{RED}[NO]{END}"
+
+
+def op_report():
+    from .ops.op_builder import ALL_OPS
+
+    max_dots = 23
+    print("-" * 64)
+    print("DeeperSpeed-TPU native op report")
+    print("-" * 64)
+    print(
+        "JIT-compiled ops build on first use with g++ and are cached; "
+        "'compatible' means the toolchain and sources are present."
+    )
+    print("-" * 64)
+    print(f"{'op name':<20} {'built (cached)':<18} compatible")
+    print("-" * 64)
+    for name, builder in sorted(ALL_OPS.items()):
+        built = builder.so_path().exists() if builder.is_compatible() else False
+        status = OKAY if builder.is_compatible() else NO
+        note = builder.compatibility_message()
+        built_str = "[CACHED]" if built else "[JIT]"
+        print(f"{name:.<{max_dots}} {built_str:<14} {status} ({note})")
+
+
+def simd_report():
+    """Host SIMD width, relevant for the native CPU Adam (csrc/adam)."""
+    flags = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    flags = line
+                    break
+    except OSError:
+        pass
+    if "avx512f" in flags:
+        return "AVX512"
+    if "avx2" in flags:
+        return "AVX256"
+    return "scalar"
+
+
+def environment_report():
+    print("-" * 64)
+    print("DeeperSpeed-TPU general environment info:")
+    print("-" * 64)
+    print(f"python version ......... {sys.version.split()[0]}")
+    try:
+        import jax
+        import jaxlib
+
+        print(f"jax version ............ {jax.__version__}")
+        print(f"jaxlib version ......... {jaxlib.__version__}")
+        devices = jax.devices()
+        plat = devices[0].platform
+        print(f"platform ............... {plat}")
+        print(f"device count ........... {len(devices)}")
+        print(f"local device count ..... {jax.local_device_count()}")
+        print(f"process count .......... {jax.process_count()}")
+        if plat == "tpu":
+            print(f"device kind ............ {devices[0].device_kind}")
+    except Exception as e:  # jax init can fail off-accelerator
+        print(f"jax .................... unavailable ({e})")
+    from .version import __version__
+
+    print(f"deeperspeed_tpu version  {__version__}")
+    import deeperspeed_tpu
+
+    print(
+        "deeperspeed_tpu install path "
+        f"{os.path.dirname(deeperspeed_tpu.__file__)}"
+    )
+    print(f"host SIMD .............. {simd_report()}")
+
+
+def main():
+    op_report()
+    environment_report()
+
+
+def cli_main():
+    main()
+
+
+if __name__ == "__main__":
+    main()

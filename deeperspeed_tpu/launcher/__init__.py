@@ -1,0 +1,17 @@
+"""Multi-host launcher: ``python -m deeperspeed_tpu.launcher <script>``.
+
+TPU-native analog of the reference deepspeed CLI (bin/deepspeed ->
+deepspeed/launcher/runner.py): hostfile + include/exclude resource
+selection, pdsh/ssh/mpirun/gcloud fan-out, per-node process spawn with
+jax.distributed rendezvous env.
+"""
+
+from .runner import (
+    encode_world_info,
+    fetch_hostfile,
+    main,
+    parse_args,
+    parse_inclusion_exclusion,
+    parse_resource_filter,
+)
+from .launch import plan_node_processes

@@ -1,0 +1,21 @@
+"""Launcher constants (reference: deepspeed/launcher/constants.py)."""
+
+PDSH_LAUNCHER = "pdsh"
+OPENMPI_LAUNCHER = "openmpi"
+GCLOUD_LAUNCHER = "gcloud"
+SSH_LAUNCHER = "ssh"
+
+PDSH_MAX_FAN_OUT = 1024
+
+# Default coordinator port for jax.distributed (analog of
+# TORCH_DISTRIBUTED_DEFAULT_PORT=29500 in reference deepspeed/constants.py).
+DISTRIBUTED_DEFAULT_PORT = 29500
+
+DEFAULT_HOSTFILE = "/job/hostfile"
+
+# Env prefixes forwarded to remote workers (reference launcher/runner.py:27
+# exports NCCL/PYTHON/MV2/UCX; on TPU the relevant knobs are JAX/XLA/TPU/
+# LIBTPU plus the python environment).
+EXPORT_ENVS = ["JAX", "XLA", "TPU", "LIBTPU", "PYTHON", "PALLAS", "DS_TPU"]
+
+ENVIRONMENT_FILE = ".deeperspeed_env"

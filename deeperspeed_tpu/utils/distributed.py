@@ -1,0 +1,108 @@
+"""Multi-host distributed initialization.
+
+Analog of reference deepspeed/utils/distributed.py (init_distributed :12,
+mpi_discovery :54), re-targeted at jax.distributed: instead of
+torch.distributed.init_process_group over NCCL, we start the JAX
+coordination service so every host sees the global TPU mesh.
+
+Discovery order:
+1. explicit arguments
+2. DS_COORDINATOR_ADDRESS / DS_NUM_PROCESSES / DS_PROCESS_ID (set by
+   deeperspeed_tpu.launcher.launch)
+3. MASTER_ADDR / MASTER_PORT / WORLD_SIZE / RANK (reference-compatible)
+4. OpenMPI env (OMPI_COMM_WORLD_*) — the mpirun launch path
+5. single-process fallback (no-op)
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from .logging import logger
+
+_initialized = False
+
+
+def mpi_discovery():
+    """Read rank/world from the OpenMPI environment (reference
+    utils/distributed.py:54 uses mpi4py; env vars avoid the dependency)."""
+    env = os.environ
+    if "OMPI_COMM_WORLD_SIZE" not in env:
+        return None
+    world_size = int(env["OMPI_COMM_WORLD_SIZE"])
+    rank = int(env["OMPI_COMM_WORLD_RANK"])
+    master_addr = env.get("MASTER_ADDR", "127.0.0.1")
+    master_port = env.get("MASTER_PORT", "29500")
+    return dict(
+        coordinator_address=f"{master_addr}:{master_port}",
+        num_processes=world_size,
+        process_id=rank,
+    )
+
+
+def discover():
+    env = os.environ
+    if "DS_COORDINATOR_ADDRESS" in env:
+        return dict(
+            coordinator_address=env["DS_COORDINATOR_ADDRESS"],
+            num_processes=int(env["DS_NUM_PROCESSES"]),
+            process_id=int(env["DS_PROCESS_ID"]),
+        )
+    if "MASTER_ADDR" in env and "WORLD_SIZE" in env and "RANK" in env:
+        return dict(
+            coordinator_address=(
+                f"{env['MASTER_ADDR']}:{env.get('MASTER_PORT', '29500')}"
+            ),
+            num_processes=int(env["WORLD_SIZE"]),
+            process_id=int(env["RANK"]),
+        )
+    return mpi_discovery()
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    auto_mpi_discovery: bool = True,
+) -> bool:
+    """Initialize jax.distributed for multi-host execution.
+
+    Returns True if multi-host init ran (or already had), False for the
+    single-process fallback. Idempotent, like the reference's guard on
+    torch.distributed.is_initialized().
+    """
+    global _initialized
+    if _initialized:
+        return True
+
+    if coordinator_address is None:
+        found = discover() if auto_mpi_discovery else None
+        if found is None:
+            logger.info(
+                "No distributed environment detected; running single-process."
+            )
+            return False
+        coordinator_address = found["coordinator_address"]
+        num_processes = found["num_processes"]
+        process_id = found["process_id"]
+
+    if num_processes is None or num_processes <= 1:
+        return False
+
+    import jax
+
+    logger.info(
+        "jax.distributed.initialize(coordinator=%s, num_processes=%d, "
+        "process_id=%d)",
+        coordinator_address,
+        num_processes,
+        process_id,
+    )
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    return True
