@@ -138,10 +138,10 @@ def main(args=None):
         env["DS_COORDINATOR_ADDRESS"] = f"{args.master_addr}:{args.master_port}"
         env["DS_NUM_PROCESSES"] = str(plan["num_processes"])
         env["DS_PROCESS_ID"] = str(plan["process_id"])
-        # chip visibility for multi-process-per-host layouts (libtpu infers
-        # the per-process topology from the visible-chip list)
-        if args.procs_per_node > 1:
-            env["TPU_VISIBLE_CHIPS"] = ",".join(map(str, plan["chips"]))
+        # chip visibility (libtpu infers the per-process topology from the
+        # visible-chip list); always set so slot filters (--num_chips,
+        # --exclude, include slot lists) restrict the chips actually used
+        env["TPU_VISIBLE_CHIPS"] = ",".join(map(str, plan["chips"]))
         # reference-compatible env (launch.py sets RANK/LOCAL_RANK/...)
         env["RANK"] = str(plan["process_id"])
         env["LOCAL_RANK"] = str(plan["local_rank"])

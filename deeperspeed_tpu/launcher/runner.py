@@ -330,8 +330,9 @@ def main(args=None):
     multi_node_exec = args.force_multi or len(active_resources) > 1
 
     if not multi_node_exec:
-        node_rank = int(os.environ.get("RANK", 0)) or None
-        cmd = _build_launch_cmd(args, world_info_base64, node_rank=node_rank)
+        # single-node world_info always has exactly one node; never inherit
+        # a stale RANK from the shell as a node rank
+        cmd = _build_launch_cmd(args, world_info_base64, node_rank=None)
     else:
         launcher = args.launcher.lower()
         if launcher == PDSH_LAUNCHER:
@@ -370,8 +371,9 @@ def main(args=None):
     logger.info("cmd = %s", " ".join(cmd))
     result = subprocess.Popen(cmd, env=env)
     result.wait()
-    if result.returncode > 0:
-        sys.exit(result.returncode)
+    if result.returncode != 0:
+        # negative returncode = killed by signal; surface as failure too
+        sys.exit(result.returncode if result.returncode > 0 else 1)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,16 @@
+from .config import ActivationCheckpointingConfig
+from .checkpointing import (
+    checkpoint,
+    checkpoint_wrapped,
+    checkpoint_name,
+    configure,
+    is_configured,
+    reset,
+    make_remat_policy,
+    partition_activations_spec,
+    RNGStatesTracker,
+    get_rng_tracker,
+    get_cuda_rng_tracker,
+    model_parallel_cuda_manual_seed,
+    model_parallel_seed,
+)

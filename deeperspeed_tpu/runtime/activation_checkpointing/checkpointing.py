@@ -1,0 +1,291 @@
+"""Activation checkpointing, TPU-native.
+
+Capability parity with the Megatron-style checkpointing in
+/root/reference/deepspeed/runtime/activation_checkpointing/checkpointing.py:
+`CheckpointFunction` (:356), `CudaRNGStatesTracker` (:122),
+`model_parallel_cuda_manual_seed` (:198) and `configure` (:769).
+
+The reference re-implements autograd checkpointing imperatively: stash RNG
+states + (optionally MP-partitioned / CPU-resident) inputs on forward, then
+restore RNG and re-run the block inside backward. Under XLA all of that is a
+*rematerialisation policy*:
+
+  * ``checkpoint(fn, *args)``          -> ``jax.checkpoint`` (recompute in bwd)
+  * partition_activations              -> saved residuals carry a sharding
+                                          constraint over the model axis, so
+                                          each MP rank stores 1/mp of them
+                                          (reference :418-478 scatter +
+                                          get_full_inputs allgather :256)
+  * cpu_checkpointing / checkpoint_in_cpu -> offload-to-host remat policy
+                                          (reference :478 ``.cpu()`` inputs)
+  * CudaRNGStatesTracker               -> named jax PRNG streams; `fork()`
+                                          yields a fresh subkey per use so
+                                          dropout patterns are reproducible
+                                          and distinct per named stream
+
+contiguous_memory_optimization / synchronize_checkpoint_boundary are accepted
+for config compatibility; XLA's buffer assignment already provides contiguous
+reuse, and there is no stream boundary to synchronize.
+"""
+
+import contextlib
+import dataclasses
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name  # re-export for model authors
+from jax.sharding import PartitionSpec as P
+
+from ...utils.logging import logger
+from ..config_utils import ConfigObject  # noqa: F401  (doc link)
+
+__all__ = [
+    "checkpoint",
+    "checkpoint_wrapped",
+    "checkpoint_name",
+    "configure",
+    "is_configured",
+    "reset",
+    "make_remat_policy",
+    "partition_activations_spec",
+    "RNGStatesTracker",
+    "get_rng_tracker",
+    "get_cuda_rng_tracker",
+    "model_parallel_rng_tracker_name",
+    "model_parallel_seed",
+    "model_parallel_cuda_manual_seed",
+]
+
+# Named RNG stream used for model-parallel regions (dropout inside sharded
+# blocks), mirroring _MODEL_PARALLEL_RNG_TRACKER_NAME (reference :118).
+_MODEL_PARALLEL_RNG_TRACKER_NAME = "model-parallel-rng"
+_DEFAULT_RNG_TRACKER_NAME = "default-rng"
+
+# Seed offset between the data-parallel and model-parallel streams
+# (reference :225: ``offset = seed + 2718``).
+_MODEL_PARALLEL_SEED_OFFSET = 2718
+
+
+@dataclasses.dataclass
+class _CheckpointConfig:
+    partition_activations: bool = False
+    contiguous_memory_optimization: bool = False
+    num_checkpoints: Optional[int] = None
+    cpu_checkpointing: bool = False
+    synchronize: bool = False
+    profile: bool = False
+    mpu: Any = None
+    configured: bool = False
+
+
+_config = _CheckpointConfig()
+
+
+def configure(
+    mpu_=None,
+    deepspeed_config=None,
+    partition_activations=None,
+    contiguous_checkpointing=None,
+    num_checkpoints=None,
+    checkpoint_in_cpu=None,
+    synchronize=None,
+    profile=None,
+):
+    """Configure module-level checkpointing state (reference :769).
+
+    Explicit keyword arguments override the ``activation_checkpointing``
+    block of ``deepspeed_config`` (a TrainingConfig or raw dict).
+    """
+    global _config
+    cfg = _CheckpointConfig(mpu=mpu_, configured=True)
+
+    block = None
+    if deepspeed_config is not None:
+        block = getattr(deepspeed_config, "activation_checkpointing_config", None)
+        if block is None:
+            from .config import ActivationCheckpointingConfig
+
+            block = ActivationCheckpointingConfig(
+                deepspeed_config if isinstance(deepspeed_config, dict) else None
+            )
+    if block is not None:
+        cfg.partition_activations = block.partition_activations
+        cfg.contiguous_memory_optimization = block.contiguous_memory_optimization
+        cfg.num_checkpoints = block.number_checkpoints
+        cfg.cpu_checkpointing = block.cpu_checkpointing
+        cfg.synchronize = block.synchronize_checkpoint_boundary
+        cfg.profile = block.profile
+
+    if partition_activations is not None:
+        cfg.partition_activations = partition_activations
+    if contiguous_checkpointing is not None:
+        cfg.contiguous_memory_optimization = contiguous_checkpointing
+    if num_checkpoints is not None:
+        cfg.num_checkpoints = num_checkpoints
+    if checkpoint_in_cpu is not None:
+        cfg.cpu_checkpointing = checkpoint_in_cpu
+    if synchronize is not None:
+        cfg.synchronize = synchronize
+    if profile is not None:
+        cfg.profile = profile
+
+    if cfg.contiguous_memory_optimization and cfg.num_checkpoints is None:
+        # the reference asserts here (:782); XLA needs no buffer count, so
+        # just note that the knob is vestigial
+        logger.debug("contiguous_memory_optimization has no effect under XLA")
+    _config = cfg
+    return _config
+
+
+def is_configured() -> bool:
+    """True after configure() (reference :800)."""
+    return _config.configured
+
+
+def reset():
+    """Forget configuration + RNG streams (reference reset() clears buffers)."""
+    global _config
+    _config = _CheckpointConfig()
+    get_rng_tracker().reset()
+
+
+def make_remat_policy(
+    cpu_checkpointing: Optional[bool] = None,
+    save_names=(),
+    offload_names=(),
+):
+    """Build a `jax.checkpoint` policy from the configured state.
+
+    Default is full recompute (``nothing_saveable`` — exactly the reference
+    CheckpointFunction, which saves only the block inputs). With
+    cpu_checkpointing, tensors tagged via ``checkpoint_name`` in
+    ``offload_names`` (default: everything the policy sees named) are kept
+    but moved to host memory, the analog of reference :478 input offload.
+    """
+    cpu = _config.cpu_checkpointing if cpu_checkpointing is None else cpu_checkpointing
+    cp = jax.checkpoint_policies
+    if save_names or offload_names:
+        return cp.save_and_offload_only_these_names(
+            names_which_can_be_saved=list(save_names),
+            names_which_can_be_offloaded=list(offload_names),
+            offload_src="device",
+            offload_dst="pinned_host",
+        )
+    if cpu:
+        # no explicit names: offload the matmul outputs (the big residuals)
+        return cp.offload_dot_with_no_batch_dims("device", "pinned_host")
+    return cp.nothing_saveable
+
+
+def partition_activations_spec(ndim: int, axis_name: str = "model") -> P:
+    """PartitionSpec sharding the leading dim of a saved activation across
+    the model axis — the XLA analog of scattering checkpointed inputs across
+    MP ranks (reference partition_activations :418-478). Apply with
+    ``jax.lax.with_sharding_constraint`` on values you tag as saved."""
+    return P(axis_name, *([None] * (ndim - 1)))
+
+
+def checkpoint_wrapped(function: Callable, policy=None, prevent_cse: bool = True):
+    """Return ``function`` wrapped for rematerialisation."""
+    if policy is None:
+        policy = make_remat_policy()
+    return jax.checkpoint(function, policy=policy, prevent_cse=prevent_cse)
+
+
+def checkpoint(function: Callable, *args):
+    """Checkpoint a forward block (reference CheckpointFunction.apply :356).
+
+    ``checkpoint(fn, *args)`` runs fn under remat; ``checkpoint(fn)`` returns
+    the wrapped callable. Gradients flowing through the result recompute the
+    block instead of storing its internals.
+    """
+    wrapped = checkpoint_wrapped(function)
+    if not args:
+        return wrapped
+    return wrapped(*args)
+
+
+# ---------------------------------------------------------------------------
+# RNG state tracking (reference CudaRNGStatesTracker :122)
+# ---------------------------------------------------------------------------
+
+
+class RNGStatesTracker:
+    """Named, reproducible PRNG streams.
+
+    The reference forks the CUDA RNG to a named state, runs the region, and
+    restores (:162-195). With stateless jax PRNG the equivalent is a named
+    key that is split on every `fork()` use: distinct streams are
+    independent, and re-seeding reproduces the exact sequence — which is what
+    checkpointed recomputation relies on.
+    """
+
+    def __init__(self):
+        self.states_: Dict[str, jax.Array] = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def get_states(self) -> Dict[str, jax.Array]:
+        return dict(self.states_)
+
+    def set_states(self, states: Dict[str, jax.Array]):
+        if not isinstance(states, dict):
+            raise RuntimeError("states must be a dict")
+        self.states_ = dict(states)
+
+    def add(self, name: str, seed: int):
+        if seed in self.seeds_:
+            raise RuntimeError(f"seed {seed} already present")
+        if name in self.states_:
+            raise RuntimeError(f"rng state {name} already present")
+        self.seeds_.add(seed)
+        self.states_[name] = jax.random.PRNGKey(seed)
+
+    @contextlib.contextmanager
+    def fork(self, name: str = _MODEL_PARALLEL_RNG_TRACKER_NAME):
+        """Yield a fresh subkey from the named stream and advance it."""
+        if name not in self.states_:
+            raise RuntimeError(f"rng state {name} is not added")
+        self.states_[name], sub = jax.random.split(self.states_[name])
+        yield sub
+
+
+_RNG_TRACKER = RNGStatesTracker()
+
+
+def get_rng_tracker() -> RNGStatesTracker:
+    return _RNG_TRACKER
+
+
+# reference-compatible alias (get_cuda_rng_tracker :195)
+get_cuda_rng_tracker = get_rng_tracker
+
+
+def model_parallel_rng_tracker_name() -> str:
+    return _MODEL_PARALLEL_RNG_TRACKER_NAME
+
+
+def model_parallel_seed(seed: int, mp_rank: int) -> int:
+    """Per-MP-rank seed for the model-parallel stream (reference :225-228)."""
+    return seed + _MODEL_PARALLEL_SEED_OFFSET + mp_rank
+
+
+def model_parallel_cuda_manual_seed(seed: int, mp_rank: Optional[int] = None):
+    """Seed both RNG streams (reference model_parallel_cuda_manual_seed :198).
+
+    default stream: `seed` (same across MP ranks — e.g. data-order dropout);
+    model-parallel stream: seed + 2718 + mp_rank (distinct per MP rank so
+    sharded dropout masks differ per partition).
+    """
+    if mp_rank is None:
+        mpu = _config.mpu
+        mp_rank = mpu.get_model_parallel_rank() if mpu is not None else 0
+    tracker = get_rng_tracker()
+    tracker.reset()
+    tracker.add(_DEFAULT_RNG_TRACKER_NAME, seed)
+    tracker.add(_MODEL_PARALLEL_RNG_TRACKER_NAME, model_parallel_seed(seed, mp_rank))
+    return tracker

@@ -34,6 +34,20 @@ def mpi_discovery():
     rank = int(env["OMPI_COMM_WORLD_RANK"])
     master_addr = env.get("MASTER_ADDR", "127.0.0.1")
     master_port = env.get("MASTER_PORT", "29500")
+    # mpirun starts one rank per slot with no per-rank env, so chip
+    # visibility must be derived here from the node-local rank (the analog
+    # of the reference selecting cuda device by LOCAL_RANK). Must run
+    # before jax initializes its backend.
+    local_size = int(env.get("OMPI_COMM_WORLD_LOCAL_SIZE", "1"))
+    if local_size > 1 and "TPU_VISIBLE_CHIPS" not in env:
+        import sys as _sys
+
+        os.environ["TPU_VISIBLE_CHIPS"] = env["OMPI_COMM_WORLD_LOCAL_RANK"]
+        if "jax" in _sys.modules:
+            logger.warning(
+                "jax imported before mpi_discovery(); TPU_VISIBLE_CHIPS may "
+                "not take effect — call init_distributed before importing jax"
+            )
     return dict(
         coordinator_address=f"{master_addr}:{master_port}",
         num_processes=world_size,
@@ -86,8 +100,21 @@ def init_distributed(
         coordinator_address = found["coordinator_address"]
         num_processes = found["num_processes"]
         process_id = found["process_id"]
+    elif num_processes is None or process_id is None:
+        # explicit address but incomplete shape: fill from the environment,
+        # and fail loudly rather than silently running single-process
+        found = discover() if auto_mpi_discovery else None
+        if found is not None:
+            num_processes = found["num_processes"] if num_processes is None else num_processes
+            process_id = found["process_id"] if process_id is None else process_id
+        if num_processes is None or process_id is None:
+            raise ValueError(
+                "init_distributed(coordinator_address=...) also needs "
+                "num_processes and process_id (not found in environment)"
+            )
 
-    if num_processes is None or num_processes <= 1:
+    if num_processes <= 1:
+        logger.info("num_processes<=1; running single-process.")
         return False
 
     import jax
