@@ -20,6 +20,8 @@ from .parallel.topology import (
     PipelineParallelGrid,
     build_mesh,
 )
+from .ops.transformer import DeepSpeedTransformerLayer, DeepSpeedTransformerConfig
+from .module_inject import replace_transformer_layer, module_inject
 from .utils import logger, log_dist
 from .utils.distributed import init_distributed
 

@@ -1,0 +1,6 @@
+from .replace_module import (
+    HFBertLayerPolicy,
+    extract_layer_params,
+    replace_transformer_layer,
+    module_inject,
+)

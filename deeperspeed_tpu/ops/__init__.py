@@ -1,3 +1,8 @@
 from .adam import FusedAdam, DeepSpeedCPUAdam, AdamState
 from .lamb import FusedLamb, LambState
 from .sgd import SGD, SGDState
+from .transformer import (
+    TransformerConfig,
+    DeepSpeedTransformerConfig,
+    DeepSpeedTransformerLayer,
+)

@@ -1,0 +1,8 @@
+from .transformer import (
+    TransformerConfig,
+    DeepSpeedTransformerConfig,
+    DeepSpeedTransformerLayer,
+    init_transformer_params,
+    transformer_layer_fn,
+    clear_layer_fn_cache,
+)

@@ -1,0 +1,245 @@
+"""Fused transformer layer vs huggingface BERT reference.
+
+Analog of reference tests/unit/test_cuda_forward.py / test_cuda_backward.py:
+the fused layer must match the HF BertLayer over shape grids within
+tolerance, with weights carried over by module injection."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeperspeed_tpu.ops.transformer import (
+    DeepSpeedTransformerConfig,
+    DeepSpeedTransformerLayer,
+    init_transformer_params,
+    transformer_layer_fn,
+)
+from deeperspeed_tpu.ops.transformer.transformer import _transformer_forward
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+from transformers.models.bert.configuration_bert import BertConfig
+from transformers.models.bert.modeling_bert import BertLayer
+
+from deeperspeed_tpu.module_inject import (
+    HFBertLayerPolicy,
+    extract_layer_params,
+    replace_transformer_layer,
+)
+
+
+def _hf_layer(hidden=64, heads=4, inter=128, seed=0):
+    torch.manual_seed(seed)
+    cfg = BertConfig(
+        hidden_size=hidden,
+        num_attention_heads=heads,
+        intermediate_size=inter,
+        num_hidden_layers=2,
+        hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0,
+    )
+    cfg._attn_implementation = "eager"
+    layer = BertLayer(cfg).eval()
+    return cfg, layer
+
+
+def _ds_config(cfg, **kw):
+    defaults = dict(
+        batch_size=-1,
+        hidden_size=cfg.hidden_size,
+        intermediate_size=cfg.intermediate_size,
+        heads=cfg.num_attention_heads,
+        attn_dropout_ratio=0.0,
+        hidden_dropout_ratio=0.0,
+        num_hidden_layers=cfg.num_hidden_layers,
+        initializer_range=cfg.initializer_range,
+        fp16=False,
+        pre_layer_norm=False,
+        attn_impl="xla",
+    )
+    defaults.update(kw)
+    return DeepSpeedTransformerConfig(**defaults)
+
+
+@pytest.mark.parametrize("batch,seq", [(2, 16), (1, 33), (3, 8)])
+def test_forward_matches_hf_bert(batch, seq):
+    cfg, layer = _hf_layer()
+    params = extract_layer_params(HFBertLayerPolicy(layer))
+    ds = DeepSpeedTransformerLayer(_ds_config(cfg))
+
+    x = np.random.RandomState(0).randn(batch, seq, cfg.hidden_size).astype(np.float32)
+    with torch.no_grad():
+        ref = layer(torch.from_numpy(x))[0].numpy()
+    out = np.asarray(ds.apply(params, jnp.asarray(x)))
+    np.testing.assert_allclose(out, ref, atol=2e-4, rtol=2e-4)
+
+
+def test_forward_matches_hf_bert_with_padding_mask():
+    cfg, layer = _hf_layer(seed=1)
+    params = extract_layer_params(HFBertLayerPolicy(layer))
+    ds = DeepSpeedTransformerLayer(_ds_config(cfg))
+
+    B, S = 2, 12
+    x = np.random.RandomState(1).randn(B, S, cfg.hidden_size).astype(np.float32)
+    pad = np.ones((B, S), np.float32)
+    pad[0, 8:] = 0  # pad out the tail of sequence 0
+    additive = (1.0 - pad)[:, None, None, :] * -10000.0
+    with torch.no_grad():
+        ref = layer(torch.from_numpy(x), attention_mask=torch.from_numpy(additive))[0].numpy()
+    out = np.asarray(
+        ds.apply(params, jnp.asarray(x), attention_mask=jnp.asarray(additive))
+    )
+    # padded positions' outputs are allowed to differ only where masked inputs
+    # feed them; compare un-padded rows
+    np.testing.assert_allclose(out[1], ref[1], atol=2e-4, rtol=2e-4)
+    np.testing.assert_allclose(out[0, :8], ref[0, :8], atol=2e-4, rtol=2e-4)
+
+
+def test_replace_transformer_layer_end_to_end():
+    from transformers.models.bert.modeling_bert import BertModel
+
+    cfg = BertConfig(
+        hidden_size=32,
+        num_attention_heads=2,
+        intermediate_size=64,
+        num_hidden_layers=3,
+        hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0,
+    )
+    torch.manual_seed(0)
+    model = BertModel(cfg).eval()
+    ds_layer, params_list, stacked = replace_transformer_layer(
+        model=model, fp16=False, attn_impl="xla"
+    )
+    assert len(params_list) == 3
+    assert stacked["attn_qkvw"].shape == (3, 32, 96)
+
+    # full-encoder parity: chain our layer 3x vs HF encoder
+    x = np.random.RandomState(2).randn(2, 10, 32).astype(np.float32)
+    h = jnp.asarray(x)
+    for p in params_list:
+        h = ds_layer.apply(p, h)
+    with torch.no_grad():
+        ref = model.encoder(torch.from_numpy(x))[0].numpy()
+    np.testing.assert_allclose(np.asarray(h), ref, atol=5e-4, rtol=5e-4)
+
+
+def test_flash_and_xla_paths_agree_fwd_bwd():
+    cfg, _ = _hf_layer(hidden=64, heads=2)
+    rng = jax.random.PRNGKey(0)
+    conf_x = _ds_config(cfg, attn_impl="xla", pre_layer_norm=True)
+    conf_f = _ds_config(cfg, attn_impl="flash", pre_layer_norm=True, interpret=True)
+    params = init_transformer_params(rng, conf_x)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 128, 64), jnp.float32)
+
+    def loss(params, conf):
+        return jnp.sum(_transformer_forward(params, x, conf) ** 2)
+
+    vx, gx = jax.value_and_grad(loss)(params, conf_x)
+    vf, gf = jax.value_and_grad(loss)(params, conf_f)
+    np.testing.assert_allclose(vx, vf, rtol=2e-3)
+    for a, b in zip(jax.tree.leaves(gx), jax.tree.leaves(gf)):
+        np.testing.assert_allclose(a, b, atol=2e-2, rtol=2e-2)
+
+
+def test_flash_with_mask_raises():
+    cfg, _ = _hf_layer()
+    conf = _ds_config(cfg, attn_impl="flash")
+    params = init_transformer_params(jax.random.PRNGKey(0), conf)
+    x = jnp.ones((1, 8, 64))
+    with pytest.raises(ValueError):
+        _transformer_forward(params, x, conf, attention_mask=jnp.zeros((1, 1, 1, 8)))
+
+
+def test_remat_knobs_preserve_values():
+    cfg, _ = _hf_layer()
+    base = _ds_config(cfg, pre_layer_norm=True)
+    remat = _ds_config(cfg, pre_layer_norm=True, normalize_invertible=True,
+                       gelu_checkpoint=True, attn_dropout_checkpoint=True)
+    params = init_transformer_params(jax.random.PRNGKey(0), base)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 16, 64))
+
+    def loss(params, conf):
+        return jnp.sum(_transformer_forward(params, x, conf) ** 2)
+
+    v0, g0 = jax.value_and_grad(loss)(params, base)
+    v1, g1 = jax.value_and_grad(loss)(params, remat)
+    np.testing.assert_allclose(v0, v1, rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5)
+
+
+def test_dropout_needs_rng_and_is_deterministic_given_key():
+    cfg, _ = _hf_layer()
+    conf = _ds_config(cfg, attn_dropout_ratio=0.5, hidden_dropout_ratio=0.5)
+    params = init_transformer_params(jax.random.PRNGKey(0), conf)
+    x = jnp.ones((1, 8, 64))
+    # no rng -> inference path, no dropout: twice the same
+    a = _transformer_forward(params, x, conf)
+    b = _transformer_forward(params, x, conf)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+    # same key same mask; different key different mask
+    r1 = _transformer_forward(params, x, conf, rng=jax.random.PRNGKey(3))
+    r2 = _transformer_forward(params, x, conf, rng=jax.random.PRNGKey(3))
+    r3 = _transformer_forward(params, x, conf, rng=jax.random.PRNGKey(4))
+    np.testing.assert_allclose(np.asarray(r1), np.asarray(r2))
+    assert not np.allclose(np.asarray(r1), np.asarray(r3))
+
+
+def test_config_from_dict_and_cache():
+    conf = DeepSpeedTransformerConfig.from_dict(
+        {"hidden_size": 32, "heads": 2, "intermediate_size": 64}
+    )
+    assert conf.hidden_size == 32
+    f1 = transformer_layer_fn(conf)
+    f2 = transformer_layer_fn(conf)
+    assert f1 is f2
+
+
+def test_from_dict_derives_intermediate_size():
+    conf = DeepSpeedTransformerConfig.from_dict({"hidden_size": 64, "heads": 4})
+    assert conf.intermediate_size == 256
+
+
+def test_layer_instances_share_compiled_fn():
+    mk = lambda: DeepSpeedTransformerConfig(
+        hidden_size=32, heads=2, intermediate_size=64,
+        attn_dropout_ratio=0.0, hidden_dropout_ratio=0.0, attn_impl="xla",
+    )
+    l1, l2 = DeepSpeedTransformerLayer(mk()), DeepSpeedTransformerLayer(mk())
+    assert l1.config.layer_id != l2.config.layer_id  # per-instance stamp
+    assert transformer_layer_fn(l1.config) is transformer_layer_fn(l2.config)
+
+
+def test_auto_impl_falls_back_to_xla_on_cpu():
+    # seq 33 is not flash-tileable and this backend has no TPU — 'auto' must
+    # quietly take the XLA path instead of crashing in the Pallas kernel
+    cfg, layer = _hf_layer()
+    params = extract_layer_params(HFBertLayerPolicy(layer))
+    ds = DeepSpeedTransformerLayer(_ds_config(cfg, attn_impl="auto"))
+    x = np.random.RandomState(0).randn(1, 33, cfg.hidden_size).astype(np.float32)
+    with torch.no_grad():
+        ref = layer(torch.from_numpy(x))[0].numpy()
+    out = np.asarray(ds.apply(params, jnp.asarray(x)))
+    np.testing.assert_allclose(out, ref, atol=2e-4, rtol=2e-4)
+
+
+def test_attention_dropout_applied_to_probs():
+    cfg, _ = _hf_layer()
+    conf = _ds_config(cfg, attn_dropout_ratio=0.9, attn_impl="auto")
+    params = init_transformer_params(jax.random.PRNGKey(0), conf)
+    x = jnp.ones((1, 8, 64))
+    clean = _transformer_forward(params, x, _ds_config(cfg, attn_impl="auto"))
+    dropped = _transformer_forward(params, x, conf, rng=jax.random.PRNGKey(1))
+    assert not np.allclose(np.asarray(clean), np.asarray(dropped))
+
+
+def test_bf16_compute_dtype():
+    cfg, _ = _hf_layer()
+    conf = _ds_config(cfg, fp16=True, pre_layer_norm=True)
+    assert conf.compute_dtype == jnp.bfloat16
+    params = init_transformer_params(jax.random.PRNGKey(0), conf)
+    x = jnp.ones((1, 8, 64), jnp.bfloat16)
+    out = _transformer_forward(params, x, conf)
+    assert out.dtype == jnp.bfloat16
