@@ -91,12 +91,15 @@ def replace_transformer_layer(
     huggingface: bool = False,
     policy_cls=HFBertLayerPolicy,
     attn_impl: str = "auto",
+    stack: bool = True,
 ):
     """Reference replace_module.py:6, re-expressed as extraction.
 
     Returns ``(ds_layer, params_list, stacked_params)``: a fused
     ``DeepSpeedTransformerLayer`` whose apply consumes each element of
-    ``params_list`` (or a lax.scan over ``stacked_params``).
+    ``params_list`` (or a lax.scan over ``stacked_params``). With
+    ``stack=False`` the stacked copy is skipped (halves injection memory
+    when only the per-layer list is needed) and ``stacked_params`` is None.
     """
     if orig_layer_impl is None:
         orig_layer_impl = policy_cls.orig_layer_class()
@@ -116,6 +119,7 @@ def replace_transformer_layer(
         hidden_dropout_ratio=getattr(hf_config, "hidden_dropout_prob", 0.0),
         num_hidden_layers=getattr(hf_config, "num_hidden_layers", len(layers)),
         initializer_range=getattr(hf_config, "initializer_range", 0.02),
+        layernorm_eps=getattr(hf_config, "layer_norm_eps", 1e-12),
         seed=seed,
         fp16=fp16,
         pre_layer_norm=preln,
@@ -123,9 +127,11 @@ def replace_transformer_layer(
         attn_impl=attn_impl,
     )
     params_list = [extract_layer_params(policy_cls(layer)) for layer in layers]
-    stacked = {
-        k: jnp.stack([p[k] for p in params_list]) for k in params_list[0]
-    }
+    stacked = None
+    if stack:
+        stacked = {
+            k: jnp.stack([p[k] for p in params_list]) for k in params_list[0]
+        }
     ds_layer = DeepSpeedTransformerLayer(ds_config)
     logger.info("injected %d %s layers into DeepSpeedTransformerLayer(params)",
                 len(layers), orig_layer_impl.__name__)

@@ -75,7 +75,7 @@ class DeepSpeedTransformerConfig(TransformerConfig):
                  gelu_checkpoint=False, adjust_init_range=True,
                  attn_dropout_checkpoint=False, stochastic_mode=False,
                  huggingface=False, training=True, attn_impl="auto",
-                 interpret=False):
+                 interpret=False, layernorm_eps=1e-12):
         super().__init__(
             batch_size,
             hidden_size,
@@ -100,6 +100,7 @@ class DeepSpeedTransformerConfig(TransformerConfig):
         self.training = training
         self.attn_impl = attn_impl
         self.interpret = interpret  # pallas interpret mode (CPU testing)
+        self.layernorm_eps = layernorm_eps
 
     @property
     def compute_dtype(self):
@@ -207,21 +208,25 @@ def _attention_core(q, k, v, config, attention_mask, drop_rng=None):
 
 
 def _transformer_forward(params, x, config: DeepSpeedTransformerConfig,
-                         attention_mask=None, rng=None):
+                         attention_mask=None, rng=None, pld_theta=None):
     """One BERT layer: attn -> add&norm -> gelu MLP -> add&norm, pre- or
-    post-LN (reference DeepSpeedTransformerFunction.forward :155)."""
+    post-LN (reference DeepSpeedTransformerFunction.forward :155).
+    With stochastic_mode (progressive layer drop) the whole layer is kept
+    with probability ``pld_theta``, identity otherwise."""
     B, S, H = x.shape
     nh = config.heads
     dh = H // nh
     dtype = config.compute_dtype
     x = x.astype(dtype)
     p = {k: v.astype(dtype) for k, v in params.items()}
-    r1 = r2 = r3 = None
+    r1 = r2 = r3 = gate_rng = None
     if rng is not None and config.training:
-        r1, r2, r3 = jax.random.split(rng, 3)
+        r1, r2, r3, gate_rng = jax.random.split(rng, 4)
+
+    eps = config.layernorm_eps
 
     def attn_block(x):
-        h = _layer_norm(x, p["attn_nw"], p["attn_nb"]) if config.pre_layer_norm else x
+        h = _layer_norm(x, p["attn_nw"], p["attn_nb"], eps) if config.pre_layer_norm else x
         qkv = h @ p["attn_qkvw"] + p["attn_qkvb"]
         q, k, v = jnp.split(qkv, 3, axis=-1)
         shp = (B, S, nh, dh)
@@ -232,7 +237,7 @@ def _transformer_forward(params, x, config: DeepSpeedTransformerConfig,
         return _dropout(out, config.hidden_dropout_ratio, r2)
 
     def ffn_block(x):
-        h = _layer_norm(x, p["norm_w"], p["norm_b"]) if config.pre_layer_norm else x
+        h = _layer_norm(x, p["norm_w"], p["norm_b"], eps) if config.pre_layer_norm else x
         inter = jax.nn.gelu(h @ p["inter_w"] + p["inter_b"], approximate=False)
         out = inter @ p["output_w"] + p["output_b"]
         return _dropout(out, config.hidden_dropout_ratio, r3)
@@ -245,13 +250,17 @@ def _transformer_forward(params, x, config: DeepSpeedTransformerConfig,
     if config.normalize_invertible or config.gelu_checkpoint:
         ffn_block = jax.checkpoint(ffn_block)
 
-    if config.pre_layer_norm:
-        x = x + attn_block(x)
-        x = x + ffn_block(x)
-    else:
-        x = _layer_norm(x + attn_block(x), p["attn_nw"], p["attn_nb"])
-        x = _layer_norm(x + ffn_block(x), p["norm_w"], p["norm_b"])
-    return x
+    def full_layer(x):
+        if config.pre_layer_norm:
+            x = x + attn_block(x)
+            return x + ffn_block(x)
+        x = _layer_norm(x + attn_block(x), p["attn_nw"], p["attn_nb"], eps)
+        return _layer_norm(x + ffn_block(x), p["norm_w"], p["norm_b"], eps)
+
+    if config.stochastic_mode and pld_theta is not None and gate_rng is not None:
+        gate = jax.random.bernoulli(gate_rng, pld_theta).astype(dtype)
+        return gate * full_layer(x) + (1 - gate) * x
+    return full_layer(x)
 
 
 _LAYER_FN_CACHE = {}
@@ -333,9 +342,10 @@ class DeepSpeedTransformerLayer:
             params.update(biases_to_params(biases))
         return params
 
-    def apply(self, params, x, rng=None, attention_mask=None):
+    def apply(self, params, x, rng=None, attention_mask=None, pld_theta=None):
         return transformer_layer_fn(self.config)(
-            params, x, attention_mask=attention_mask, rng=rng
+            params, x, attention_mask=attention_mask, rng=rng,
+            pld_theta=(None if pld_theta is None else jnp.float32(pld_theta)),
         )
 
     __call__ = apply

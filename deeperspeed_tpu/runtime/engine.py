@@ -108,6 +108,18 @@ class Engine:
         self.rng = rng if rng is not None else jax.random.PRNGKey(0)
 
         self._takes_rng = _loss_fn_takes_rng(model)
+        # PLD (reference engine.py:972 passes pld.get_state() kwargs into the
+        # module forward; here theta rides along as a traced scalar)
+        self.progressive_layer_drop = None
+        if config.pld_enabled:
+            from .progressive_layer_drop import ProgressiveLayerDrop
+
+            pld_params = config.pld_params or {}
+            self.progressive_layer_drop = ProgressiveLayerDrop(
+                theta=pld_params.get("theta", 0.5),
+                gamma=pld_params.get("gamma", 0.001),
+            )
+        self._takes_pld = _loss_fn_takes_pld(model)
         self._compute_dtype = _dtype_of(config.precision)
         self.zero_stage = config.zero_optimization_stage
 
@@ -398,9 +410,27 @@ class Engine:
     # jitted computations
     # ------------------------------------------------------------------ #
 
+    def _pld_active(self) -> bool:
+        return self.progressive_layer_drop is not None and self._takes_pld
+
+    def _pack_pld(self, batch, theta: float = None):
+        """Attach the PLD keep-probability to the batch pytree so it enters
+        the jitted step as a traced scalar (no retrace as theta decays)."""
+        if not self._pld_active():
+            return batch
+        if theta is None:
+            theta = self.progressive_layer_drop.get_theta()
+        return (batch, jnp.float32(theta))
+
     def _call_loss(self, params, batch, rng, scale):
+        kwargs = {}
+        if self._pld_active():
+            batch, theta = batch
+            kwargs["pld_theta"] = theta
         out = (
-            self.loss_fn(params, batch, rng) if self._takes_rng else self.loss_fn(params, batch)
+            self.loss_fn(params, batch, rng, **kwargs)
+            if self._takes_rng
+            else self.loss_fn(params, batch, **kwargs)
         )
         loss, aux = out if isinstance(out, tuple) else (out, None)
         return (loss.astype(jnp.float32) * scale), loss
@@ -461,6 +491,11 @@ class Engine:
             grads = partition.constrain(grads, self.grad_specs, self.mesh)
             return loss, grads
 
+        # the PLD theta scalar rides outside the microbatch reshape
+        theta = None
+        if self._pld_active():
+            batch, theta = batch
+
         def resh(x):
             return jnp.reshape(x, (gas, x.shape[0] // gas) + x.shape[1:])
 
@@ -470,6 +505,8 @@ class Engine:
 
         def body(carry, mb):
             acc, loss_sum, i = carry
+            if theta is not None:
+                mb = (mb, theta)
             loss, grads = self._micro_grads(
                 state.params, mb, jax.random.fold_in(rng, i), scale
             )
@@ -631,7 +668,8 @@ class Engine:
         batch = self._place_batch(batch)
         rng, self.rng = _split(self.rng)
         if self._mode != "train":
-            return self._forward_only_fn()(self.state, batch, rng)
+            return self._forward_only_fn()(self.state, self._pack_pld(batch, 1.0), rng)
+        batch = self._pack_pld(batch)
         loss, grads = self._forward_grad_fn()(self.state, batch, rng)
         self._stashed = (loss, grads)
         return loss
@@ -680,6 +718,8 @@ class Engine:
         path stays fully async (overflow still discards the update on device)."""
         self.global_steps += 1
         self.global_samples += self.train_batch_size()
+        if self.progressive_layer_drop is not None:
+            self.progressive_layer_drop.update_state(self.global_steps)
         self._pending_metrics = metrics
         if self._loss_scaler.dynamic:
             overflow = bool(jax.device_get(metrics["overflow"]))
@@ -703,6 +743,7 @@ class Engine:
             parts = [next(it) for _ in range(self.gradient_accumulation_steps())]
             batch = jax.tree.map(lambda *xs: np.concatenate(xs, axis=0), *parts)
         batch = self._place_batch(batch)
+        batch = self._pack_pld(batch)
         rng, self.rng = _split(self.rng)
         lr = jnp.float32(self._current_lr())
         self.tput_timer.start()
@@ -722,7 +763,8 @@ class Engine:
     def eval_batch(self, batch):
         batch = self._place_batch(batch)
         rng, self.rng = _split(self.rng)
-        return self._forward_only_fn()(self.state, batch, rng)
+        # PLD keeps every layer at eval (theta pinned to 1)
+        return self._forward_only_fn()(self.state, self._pack_pld(batch, 1.0), rng)
 
     def _train_iter(self):
         if not hasattr(self, "_train_data_iter") or self._train_data_iter is None:
@@ -907,7 +949,19 @@ def _split(key):
 def _loss_fn_takes_rng(fn) -> bool:
     try:
         sig = inspect.signature(fn)
-        return len(sig.parameters) >= 3
+        kinds = [p.kind for p in sig.parameters.values()]
+        if inspect.Parameter.VAR_POSITIONAL in kinds:
+            return True  # *args catches the rng
+        return len([p for p in sig.parameters.values()
+                    if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
+                    and p.name != "pld_theta"]) >= 3
+    except (TypeError, ValueError):
+        return False
+
+
+def _loss_fn_takes_pld(fn) -> bool:
+    try:
+        return "pld_theta" in inspect.signature(fn).parameters
     except (TypeError, ValueError):
         return False
 

@@ -1,0 +1,43 @@
+"""Progressive Layer Drop (PLD) schedule.
+
+Capability parity with /root/reference/deepspeed/runtime/progressive_layer_drop.py:33
+(the PLD technique of arXiv:2010.13369): a per-step keep-probability
+
+    theta(t) = (1 - theta_min) * exp(-gamma * t) + theta_min
+
+starting at 1.0 (keep every layer) and decaying toward ``theta_min``. The
+engine updates it after every optimizer step and, when the user loss_fn
+declares a ``pld_theta`` keyword, feeds the current value in as a traced
+scalar — the jit-friendly analog of the reference passing
+``**pld.get_state()`` into module.forward (engine.py:972).
+
+Models consume theta by gating each layer with a Bernoulli draw (see
+ops/transformer stochastic_mode); at eval theta is pinned to 1.0.
+"""
+
+import math
+
+
+class ProgressiveLayerDrop:
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta = theta
+        self.gamma = gamma
+        self.current_theta = 1.0
+
+    def get_theta(self) -> float:
+        return self.current_theta
+
+    def get_state(self) -> dict:
+        """Forward kwargs, exactly the reference's dict shape."""
+        return {"progressive_layer_drop": True, "pld_theta": self.get_theta()}
+
+    def update_state(self, global_step: int):
+        self.current_theta = (
+            (1.0 - self.theta) * math.exp(-self.gamma * global_step) + self.theta
+        )
+
+    def state_dict(self) -> dict:
+        return {"current_theta": self.current_theta}
+
+    def load_state_dict(self, sd: dict):
+        self.current_theta = sd["current_theta"]

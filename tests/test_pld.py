@@ -1,0 +1,139 @@
+"""Progressive layer drop + batch-size scheduler tests (reference
+tests/unit/test_pld.py analog)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deeperspeed_tpu as deepspeed
+from deeperspeed_tpu.runtime.progressive_layer_drop import ProgressiveLayerDrop
+from deeperspeed_tpu.runtime.bs_schedules import BatchSizeScheduler
+
+
+def test_pld_theta_schedule():
+    pld = ProgressiveLayerDrop(theta=0.5, gamma=0.001)
+    assert pld.get_theta() == 1.0
+    pld.update_state(0)
+    assert pld.get_theta() == pytest.approx(1.0)
+    prev = 1.0
+    for t in (10, 100, 1000, 10000):
+        pld.update_state(t)
+        assert pld.get_theta() < prev
+        prev = pld.get_theta()
+    assert pld.get_theta() == pytest.approx(0.5, abs=1e-3)
+    state = pld.state_dict()
+    pld2 = ProgressiveLayerDrop()
+    pld2.load_state_dict(state)
+    assert pld2.get_theta() == pld.get_theta()
+
+
+def test_pld_get_state_kwargs():
+    pld = ProgressiveLayerDrop(theta=0.3)
+    st = pld.get_state()
+    assert st["progressive_layer_drop"] is True
+    assert st["pld_theta"] == 1.0
+
+
+def test_engine_passes_pld_theta():
+    seen = []
+
+    def loss_fn(params, batch, rng, pld_theta=None):
+        # traced: record symbolically, use theta so it's not dead code
+        x, y = batch
+        pred = x @ params["w"]
+        scale = 1.0 if pld_theta is None else pld_theta
+        return jnp.mean((pred - y) ** 2) * scale
+
+    params = {"w": jnp.ones((4, 1))}
+    x = np.random.RandomState(0).randn(8, 4).astype(np.float32)
+    y = np.random.RandomState(1).randn(8, 1).astype(np.float32)
+    engine, _, _, _ = deepspeed.initialize(
+        model=loss_fn,
+        model_parameters=params,
+        config_params={
+            "train_batch_size": 8,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+            "progressive_layer_drop": {"enabled": True, "theta": 0.5, "gamma": 0.1},
+        },
+    )
+    assert engine.progressive_layer_drop is not None
+    l0 = float(engine.train_batch(batch=(jnp.asarray(x), jnp.asarray(y))))
+    assert np.isfinite(l0)
+    # theta decays after steps
+    t1 = engine.progressive_layer_drop.get_theta()
+    for _ in range(5):
+        engine.train_batch(batch=(jnp.asarray(x), jnp.asarray(y)))
+    assert engine.progressive_layer_drop.get_theta() < t1
+    # eval path pins theta to 1 and works
+    ev = engine.eval_batch((jnp.asarray(x), jnp.asarray(y)))
+    assert np.isfinite(float(ev))
+
+
+def test_engine_pld_with_gradient_accumulation():
+    def loss_fn(params, batch, rng, pld_theta=None):
+        x, y = batch
+        scale = 1.0 if pld_theta is None else pld_theta
+        return jnp.mean((x @ params["w"] - y) ** 2) * scale
+
+    params = {"w": jnp.zeros((4, 1))}
+    x = np.random.RandomState(0).randn(16, 4).astype(np.float32)
+    y = x @ np.ones((4, 1), np.float32)
+    engine, _, _, _ = deepspeed.initialize(
+        model=loss_fn,
+        model_parameters=params,
+        config_params={
+            "train_batch_size": 16,
+            "gradient_accumulation_steps": 2,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+            "progressive_layer_drop": {"enabled": True},
+        },
+    )
+    l0 = float(engine.train_batch(batch=(jnp.asarray(x), jnp.asarray(y))))
+    for _ in range(10):
+        l = float(engine.train_batch(batch=(jnp.asarray(x), jnp.asarray(y))))
+    assert l < l0
+
+
+def test_transformer_stochastic_mode_gating():
+    from deeperspeed_tpu.ops.transformer import (
+        DeepSpeedTransformerConfig,
+        init_transformer_params,
+    )
+    from deeperspeed_tpu.ops.transformer.transformer import _transformer_forward
+
+    conf = DeepSpeedTransformerConfig(
+        hidden_size=32, heads=2, intermediate_size=64,
+        attn_dropout_ratio=0.0, hidden_dropout_ratio=0.0,
+        pre_layer_norm=True, stochastic_mode=True, attn_impl="xla",
+    )
+    params = init_transformer_params(jax.random.PRNGKey(0), conf)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 32))
+    rng = jax.random.PRNGKey(2)
+    # theta=1: layer always applied == no-gate forward
+    full = _transformer_forward(params, x, conf, rng=rng, pld_theta=jnp.float32(1.0))
+    base = _transformer_forward(params, x, conf)
+    np.testing.assert_allclose(np.asarray(full), np.asarray(base), atol=1e-5)
+    # theta=0: identity
+    skip = _transformer_forward(params, x, conf, rng=rng, pld_theta=jnp.float32(0.0))
+    np.testing.assert_allclose(np.asarray(skip), np.asarray(x), atol=1e-6)
+
+
+def test_batch_size_scheduler():
+    sched = BatchSizeScheduler(final_batch_size=16, num_intervals=8,
+                               warmup_num_steps=100,
+                               min_batch_size_multiplier=0.25)
+    sched.step()
+    assert sched.current_batch_size == 4  # ceil(0.25*16)
+    sizes = []
+    for _ in range(120):
+        sched.step()
+        sizes.append(sched.current_batch_size)
+    assert sizes[-1] == 16
+    assert all(b <= a for a, b in zip(sizes[1:], sizes))  # non-decreasing
+    sd = sched.state_dict()
+    s2 = BatchSizeScheduler(final_batch_size=16, num_intervals=8,
+                            warmup_num_steps=100,
+                            min_batch_size_multiplier=0.25)
+    s2.load_state_dict(sd)
+    assert s2.current_batch_size == sched.current_batch_size
