@@ -11,6 +11,7 @@ multi-host launcher. API names mirror the reference
 from .version import __version__, __version_info__
 
 from .runtime.config import TrainingConfig, DeepSpeedConfig, ConfigError
+from .runtime import zero
 from .runtime.engine import Engine, initialize
 from .runtime import lr_schedules
 from .parallel.topology import (

@@ -123,9 +123,12 @@ def sparse_embedding_grad_allreduce(
     warning via jax.debug.
     """
     csr = CSRTensor.from_dense(dense_grad, capacity=capacity)
-    dropped = jnp.sum(jnp.abs(dense_grad)) - jnp.sum(jnp.abs(csr.values))
+    total = jnp.sum(jnp.abs(dense_grad))
+    dropped = total - jnp.sum(jnp.abs(csr.values))
+    # relative tolerance: the two reductions run in different orders, so an
+    # exact ==0 comparison would false-alarm on every step
     jax.lax.cond(
-        dropped > 0,
+        dropped > 1e-5 * total + 1e-12,
         lambda: jax.debug.print(
             "WARNING: sparse_embedding_grad_allreduce truncated gradient rows "
             "(capacity {c} too small; |dropped mass|={d})", c=capacity, d=dropped

@@ -1,2 +1,7 @@
 from .config import ZeroConfig
 from . import constants, partition
+from .init_ctx import Init, GatheredParameters, materialize
+from .tiling import TiledLinear
+from .linear import LinearModuleForZeroStage3, zero3_linear
+from .contiguous_memory_allocator import ContiguousMemoryAllocator
+from .utils import is_zero_supported_optimizer

@@ -1,0 +1,147 @@
+"""Contiguous host-buffer allocator with defragmentation.
+
+Capability parity with /root/reference/deepspeed/runtime/zero/
+contiguous_memory_allocator.py: a single flat buffer carved into tensor
+views, with release + defragment so long-running swap/offload traffic does
+not fragment pinned host memory.
+
+On TPU this backs HOST staging buffers (the swap_tensor pool hands aligned
+slices of one pinned slab to the AIO layer); device memory itself is managed
+by XLA. The slab is a numpy array so views alias storage exactly like the
+reference's tensor.narrow() views.
+"""
+
+from typing import Dict
+
+import numpy as np
+
+from ...utils.logging import logger
+
+
+class BufferView:
+    """Live window into the allocator slab. Defragmentation moves tensors,
+    so a raw numpy view would silently alias stale addresses (the reference
+    re-points tensor.data during compaction); this handle resolves the
+    tensor's CURRENT address on every access instead."""
+
+    def __init__(self, alloc: "ContiguousMemoryAllocator", tensor_id: int):
+        self._alloc = alloc
+        self._tid = tensor_id
+
+    @property
+    def data(self) -> np.ndarray:
+        addr, numel = self._alloc.tensor_addresses[self._tid]
+        return self._alloc.buffer[addr:addr + numel]
+
+    def __array__(self, dtype=None, copy=None):
+        d = self.data
+        return d.astype(dtype) if dtype is not None else d
+
+    def __getitem__(self, key):
+        return self.data[key]
+
+    def __setitem__(self, key, value):
+        self.data[key] = value
+
+    def __len__(self):
+        return self._alloc.tensor_addresses[self._tid][1]
+
+    @property
+    def shape(self):
+        return (len(self),)
+
+    @property
+    def size(self):
+        return len(self)
+
+
+class ContiguousMemoryAllocator:
+    def __init__(self, size: int, dtype=np.float32):
+        self.size = size
+        self.dtype = np.dtype(dtype)
+        self.buffer = np.zeros(size, self.dtype)
+        # address -> length of free blocks
+        self.contiguous_sizes: Dict[int, int] = {0: size} if size else {}
+        # tensor_id -> (address, numel)
+        self.tensor_addresses: Dict[int, tuple] = {}
+        self.total_free = size
+        self._next_id = 0
+
+    # ------------------------------------------------------------------ #
+
+    def allocate_tensor(self, numel: int):
+        """Return (tensor_id, view). Defragments if no single free block
+        fits but total free space does (reference allocate_tensor)."""
+        if numel > self.total_free:
+            raise RuntimeError(
+                f"allocate_tensor({numel}): only {self.total_free} free"
+            )
+        addr = self._find_block(numel)
+        if addr is None:
+            self._defragment()
+            addr = self._find_block(numel)
+            assert addr is not None, "defragment failed to coalesce"
+        self._carve(addr, numel)
+        tid = self._next_id
+        self._next_id += 1
+        self.tensor_addresses[tid] = (addr, numel)
+        self.total_free -= numel
+        return tid, BufferView(self, tid)
+
+    def release_tensor(self, tensor_id: int):
+        addr, numel = self.tensor_addresses.pop(tensor_id)
+        self._free(addr, numel)
+        self.total_free += numel
+
+    def get_tensor(self, tensor_id: int) -> BufferView:
+        if tensor_id not in self.tensor_addresses:
+            raise KeyError(f"tensor {tensor_id} not allocated")
+        return BufferView(self, tensor_id)
+
+    def max_allocatable(self) -> int:
+        return max(self.contiguous_sizes.values(), default=0)
+
+    # ------------------------------------------------------------------ #
+
+    def _find_block(self, numel):
+        for addr in sorted(self.contiguous_sizes):
+            if self.contiguous_sizes[addr] >= numel:
+                return addr
+        return None
+
+    def _carve(self, addr, numel):
+        length = self.contiguous_sizes.pop(addr)
+        if length > numel:
+            self.contiguous_sizes[addr + numel] = length - numel
+
+    def _free(self, addr, numel):
+        self.contiguous_sizes[addr] = numel
+        self._coalesce()
+
+    def _coalesce(self):
+        merged = {}
+        for addr in sorted(self.contiguous_sizes):
+            length = self.contiguous_sizes[addr]
+            if merged:
+                last = max(merged)
+                if last + merged[last] == addr:
+                    merged[last] += length
+                    continue
+            merged[addr] = length
+        self.contiguous_sizes = merged
+
+    def _defragment(self):
+        """Pack live tensors to the front, preserving contents (reference's
+        copy-compaction), leaving one free tail block."""
+        logger.debug("ContiguousMemoryAllocator: defragmenting")
+        cursor = 0
+        for tid in sorted(self.tensor_addresses,
+                          key=lambda t: self.tensor_addresses[t][0]):
+            addr, numel = self.tensor_addresses[tid]
+            if addr != cursor:
+                self.buffer[cursor:cursor + numel] = self.buffer[addr:addr + numel]
+                self.tensor_addresses[tid] = (cursor, numel)
+            cursor += numel
+        self.contiguous_sizes = (
+            {cursor: self.size - cursor} if cursor < self.size else {}
+        )

@@ -1,0 +1,65 @@
+"""ZeRO-3-friendly linear op (reference /root/reference/deepspeed/runtime/
+zero/linear.py:29,102 `LinearFunctionForZeroStage3` /
+`LinearModuleForZeroStage3`).
+
+The reference re-implements nn.Linear's autograd so the weight fetched by
+stage 3 is not captured in the autograd graph (it saves input+weight ids and
+re-resolves at backward). Under XLA there is no retained graph — but the
+numerically meaningful part of the reference op is preserved here: the
+forward runs in the compute dtype (bf16) while gradients are produced in
+fp32 (the reference's fp16 Linear with fp32 grad accumulation). Expressed as
+a custom_vjp so the backward matmuls are fp32 regardless of forward dtype.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..pipe.module import Layer
+
+
+@jax.custom_vjp
+def zero3_linear(x, w, b):
+    """y = x @ w + b in x's dtype; backward in fp32."""
+    y = x @ w
+    if b is not None:
+        y = y + b
+    return y
+
+
+def _fwd(x, w, b):
+    return zero3_linear(x, w, b), (x, w, b is not None)
+
+
+def _bwd(res, g):
+    x, w, has_b = res
+    g32 = g.astype(jnp.float32)
+    x32 = x.astype(jnp.float32)
+    w32 = w.astype(jnp.float32)
+    dx = (g32 @ w32.T).astype(x.dtype)
+    dw = jnp.einsum("...i,...o->io", x32, g32)
+    db = jnp.sum(g32, axis=tuple(range(g.ndim - 1))) if has_b else None
+    return dx, dw, db
+
+
+zero3_linear.defvjp(_fwd, _bwd)
+
+
+class LinearModuleForZeroStage3(Layer):
+    """Drop-in linear layer using the fp32-backward op."""
+
+    def __init__(self, in_dim: int, out_dim: int, bias: bool = True):
+        self.in_dim, self.out_dim, self.bias = in_dim, out_dim, bias
+
+    def init(self, rng):
+        w = jax.random.normal(rng, (self.in_dim, self.out_dim), jnp.float32)
+        w = w / jnp.sqrt(jnp.float32(self.in_dim))
+        p = {"w": w}
+        if self.bias:
+            p["b"] = jnp.zeros((self.out_dim,), jnp.float32)
+        return p
+
+    def apply(self, params, x, rng=None):
+        w = params["w"].astype(x.dtype)
+        b = params.get("b")
+        b = b.astype(x.dtype) if b is not None else None
+        return zero3_linear(x, w, b)

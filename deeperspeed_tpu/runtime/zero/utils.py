@@ -1,0 +1,20 @@
+"""ZeRO utility checks (reference /root/reference/deepspeed/runtime/zero/
+utils.py:46 `is_zero_supported_optimizer`)."""
+
+from ...ops.adam import DeepSpeedCPUAdam, FusedAdam
+from ...ops.lamb import FusedLamb
+from ...ops.sgd import SGD
+from ...utils.logging import logger
+
+ZERO_SUPPORTED_OPTIMIZERS = [FusedAdam, DeepSpeedCPUAdam, FusedLamb, SGD]
+
+
+def is_zero_supported_optimizer(optimizer) -> bool:
+    ok = isinstance(optimizer, tuple(ZERO_SUPPORTED_OPTIMIZERS))
+    if not ok:
+        logger.warning(
+            "optimizer %s is not in the ZeRO-supported list %s",
+            type(optimizer).__name__,
+            [t.__name__ for t in ZERO_SUPPORTED_OPTIMIZERS],
+        )
+    return ok
