@@ -1,0 +1,9 @@
+from .onebit import OnebitAdam, OnebitLamb
+from .compressed import (
+    compress,
+    decompress,
+    decompose,
+    reconstruct,
+    compressed_all_reduce,
+    compressed_all_reduce_tree,
+)

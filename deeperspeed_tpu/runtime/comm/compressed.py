@@ -1,0 +1,106 @@
+"""24-bit compressed allreduce (fork extra; reference
+/root/reference/deepspeed/runtime/comm/compressed_ar.py:34,42).
+
+The reference decomposes fp32 into an fp16 mantissa + int8 exponent via
+frexp (24 bits/element on the wire instead of 32) and allreduces both
+pieces. Summing exponents only reconstructs the true sum when world==1 (the
+file ships as a single-process demo), so this rebuild keeps the
+decompose/reconstruct API for parity but implements the collective with
+correct mathematics: block-exponent compression. Each shard normalizes
+fixed-size blocks by their max exponent (int8) and quantizes the residual
+mantissa to fp16 — 24 bits/element shipped — then every shard rebuilds and
+sums the gathered contributions exactly.
+
+Wire cost per element over the mesh axis: 24 bits x world (all_gather),
+vs 64 bits (2x fp32) for a ring allreduce; the relative error is bounded by
+the fp16 mantissa, ~2^-11 per contribution.
+"""
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+BLOCK = 128
+
+
+# --------------------------------------------------------------------------
+# reference-compatible frexp/ldexp pieces (compressed_ar.py:22,29)
+# --------------------------------------------------------------------------
+
+
+def decompose(t) -> Tuple[jax.Array, jax.Array]:
+    """fp32 -> (fp16 mantissa in [0.5,1), int8 exponent)."""
+    m, e = jnp.frexp(t.astype(jnp.float32))
+    return m.astype(jnp.float16), e.astype(jnp.int8)
+
+
+def reconstruct(mantissa, exponent, original_dtype=jnp.float32):
+    return jnp.ldexp(mantissa.astype(jnp.float32),
+                     exponent.astype(jnp.int32)).astype(original_dtype)
+
+
+# --------------------------------------------------------------------------
+# block-exponent compression (the correct-sum wire format)
+# --------------------------------------------------------------------------
+
+
+def _compress_blocks(x32, block):
+    """(n,) fp32 -> ((nb, block) fp16 mantissas, (nb,) int8 exponents)."""
+    n = x32.shape[0]
+    nb = (n + block - 1) // block
+    pad = nb * block - n
+    xb = jnp.pad(x32, (0, pad)).reshape(nb, block)
+    # per-block max exponent; ldexp by -e brings the block into [-1, 1]
+    _, e = jnp.frexp(jnp.max(jnp.abs(xb), axis=1))
+    e = jnp.clip(e, -126, 127).astype(jnp.int8)
+    m = jnp.ldexp(xb, -e[:, None].astype(jnp.int32)).astype(jnp.float16)
+    return m, e
+
+
+def _decompress_blocks(m, e, n):
+    xb = jnp.ldexp(m.astype(jnp.float32), e[:, None].astype(jnp.int32))
+    return xb.reshape(-1)[:n]
+
+
+def compress(x, block: int = BLOCK):
+    """Flatten + block-compress any-shape fp tensor. Returns (m, e, meta)."""
+    flat = x.reshape(-1).astype(jnp.float32)
+    m, e = _compress_blocks(flat, block)
+    return m, e, (x.shape, flat.shape[0])
+
+
+def decompress(m, e, meta, dtype=jnp.float32):
+    shape, n = meta
+    return _decompress_blocks(m, e, n).reshape(shape).astype(dtype)
+
+
+def compressed_all_reduce(x, axis_name: str = "data", block: int = BLOCK,
+                          average: bool = False):
+    """SUM (or mean) allreduce over ``axis_name`` shipping 24 bits/element.
+
+    Traced inside shard_map/pmap. Each shard compresses its contribution,
+    all_gathers the (fp16 mantissa, int8 exponent) pair, and rebuilds the
+    exact sum of quantized contributions locally — unlike the reference's
+    exponent-summing demo, this is correct for any world size.
+    """
+    m, e, meta = compress(x, block)
+    ms = jax.lax.all_gather(m, axis_name)  # (W, nb, block) fp16
+    es = jax.lax.all_gather(e, axis_name)  # (W, nb) int8
+    world = ms.shape[0]
+    vals = jax.vmap(lambda mm, ee: _decompress_blocks(mm, ee, meta[1]))(ms, es)
+    total = jnp.sum(vals, axis=0)
+    if average:
+        total = total / world
+    return total.reshape(meta[0]).astype(x.dtype)
+
+
+def compressed_all_reduce_tree(tree, axis_name: str = "data",
+                               block: int = BLOCK, average: bool = False):
+    """Apply the compressed allreduce to every leaf of a grad pytree."""
+    return jax.tree.map(
+        partial(compressed_all_reduce, axis_name=axis_name, block=block,
+                average=average),
+        tree,
+    )

@@ -87,10 +87,7 @@ class Init:
                 )
         shapes = jax.eval_shape(fn, *args)
         specs = self.specs_for(shapes, tp_specs)
-        shardings = jax.tree.map(
-            lambda s: NamedSharding(self.mesh, s), specs,
-            is_leaf=lambda x: isinstance(x, P),
-        )
+        shardings = partition.named_shardings(self.mesh, specs)
         params = jax.jit(fn, out_shardings=shardings)(*args)
         if self.remote_device in ("cpu", "nvme"):
             # ZeRO-Infinity construction: shards live in host RAM; the nvme

@@ -17,15 +17,14 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..pipe.module import Layer
+from ..utils import partition_uniform
 
 
 def _split_sizes(dim: int, splits: int) -> Sequence[int]:
-    """Reference partitions with ceil/floor mix (partition_uniform); we
-    require divisibility-free support the same way: first ``dim % splits``
-    tiles get the extra element."""
-    base = dim // splits
-    rem = dim % splits
-    return [base + (1 if i < rem else 0) for i in range(splits)]
+    """Tile sizes from the shared boundary solver (runtime/utils.py
+    partition_uniform, the same split the reference tiling uses)."""
+    bounds = partition_uniform(dim, splits)
+    return [bounds[i + 1] - bounds[i] for i in range(splits)]
 
 
 class TiledLinear(Layer):
@@ -74,6 +73,8 @@ class TiledLinear(Layer):
         return p
 
     def apply(self, params, x, rng=None):
+        dt = (x[0] if isinstance(x, (list, tuple)) else x).dtype
+        params = jax.tree.map(lambda p: p.astype(dt), params)
         if self.uniform:
             if self.input_is_already_split:
                 x = jnp.concatenate(list(x), axis=-1)

@@ -116,6 +116,14 @@ def test_tiled_linear_ragged():
                                rtol=1e-5, atol=1e-5)
 
 
+def test_tiled_linear_bf16_input():
+    layer = TiledLinear(32, 16, in_splits=2, out_splits=2)
+    params = layer.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 32), jnp.bfloat16)
+    y = layer.apply(params, x)
+    assert y.dtype == jnp.bfloat16 and y.shape == (4, 16)
+
+
 def test_tiled_linear_grad_flows():
     layer = TiledLinear(16, 8, in_splits=2, out_splits=2)
     params = layer.init(jax.random.PRNGKey(0))
