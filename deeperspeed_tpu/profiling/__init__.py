@@ -1,0 +1,2 @@
+from .config import FlopsProfilerConfig
+from .flops_profiler import FlopsProfiler, get_model_profile

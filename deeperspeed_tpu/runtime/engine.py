@@ -131,6 +131,17 @@ class Engine:
             steps_per_output=config.steps_per_print,
         )
 
+        # tensorboard monitor (reference engine.py:163; writer on the first
+        # process only, as the reference gates on global rank 0)
+        self.summary_writer = None
+        if getattr(config, "tensorboard_enabled", False) and jax.process_index() == 0:
+            from ..utils.tensorboard import TensorBoardMonitor
+
+            self.summary_writer = TensorBoardMonitor(
+                output_path=config.tensorboard_output_path,
+                job_name=config.tensorboard_job_name,
+            )
+
         self.global_steps = 0
         self.global_samples = 0
         self.micro_steps = 0
@@ -670,6 +681,8 @@ class Engine:
         if self._mode != "train":
             return self._forward_only_fn()(self.state, self._pack_pld(batch, 1.0), rng)
         batch = self._pack_pld(batch)
+        if self._config.flops_profiler_config.enabled:
+            self._profile_args = (batch, rng)
         loss, grads = self._forward_grad_fn()(self.state, batch, rng)
         self._stashed = (loss, grads)
         return loss
@@ -678,7 +691,8 @@ class Engine:
         """Bank the stashed grads (reference engine.py:1040). The collective
         schedule is decided by XLA from the grad sharding constraints."""
         assert self._stashed is not None, "backward() requires a prior forward()"
-        _, grads = self._stashed
+        stashed_loss, grads = self._stashed
+        self._last_micro_loss = stashed_loss  # for step()-path monitoring
         self._stashed = None
         if self._grad_acc is None:
             self._grad_acc = grads
@@ -709,6 +723,8 @@ class Engine:
             self._grad_acc = None
             self._acc_count = 0
             self._after_optimizer_step(metrics)
+            if getattr(self, "_profile_args", None) is not None:
+                self._maybe_profile_flops(*self._profile_args)
         self.micro_steps += 1
 
     def _after_optimizer_step(self, metrics):
@@ -720,6 +736,19 @@ class Engine:
         self.global_samples += self.train_batch_size()
         if self.progressive_layer_drop is not None:
             self.progressive_layer_drop.update_state(self.global_steps)
+        if self.summary_writer is not None:
+            scalars = {"Train/Samples/lr": self._current_lr()}
+            loss = metrics.get("loss")
+            if loss is None:  # imperative path: last microbatch's loss
+                loss = getattr(self, "_last_micro_loss", None)
+            if loss is not None:
+                scalars["Train/Samples/train_loss"] = jax.device_get(loss)
+            if self._loss_scaler.dynamic:
+                scalars["Train/Samples/loss_scale"] = jax.device_get(
+                    metrics["loss_scale"]
+                )
+            self.summary_writer.write_scalars(scalars, self.global_samples)
+            self.summary_writer.flush()
         self._pending_metrics = metrics
         if self._loss_scaler.dynamic:
             overflow = bool(jax.device_get(metrics["overflow"]))
@@ -758,7 +787,30 @@ class Engine:
         self.micro_steps += self.gradient_accumulation_steps()
         self._after_optimizer_step(metrics)
         self.tput_timer.stop(global_step=True, sync_with=metrics["loss"])
+        self._maybe_profile_flops(batch, rng)
         return metrics["loss"]
+
+    def _maybe_profile_flops(self, batch, rng):
+        """One-shot flops profile at profile_step (reference engine.py:966-1019
+        triggers the profiler inside forward at that step)."""
+        fpc = self._config.flops_profiler_config
+        if not fpc.enabled or self.global_steps != fpc.profile_step:
+            return
+        from ..profiling.flops_profiler import FlopsProfiler
+
+        def fwd(params, batch, rng):
+            return self._call_loss(params, batch, rng, jnp.float32(1.0))[1]
+
+        prof = FlopsProfiler(fwd)
+        prof.start_profile(self.state.params, batch, rng)
+        # every process runs the device work; only the first writes/logs
+        if jax.process_index() == 0:
+            out = prof.print_model_profile(profile_step=self.global_steps,
+                                           top_modules=fpc.top_modules)
+            if fpc.output_file:
+                with open(fpc.output_file, "w") as f:
+                    f.write(out + "\n")
+        prof.end_profile()
 
     def eval_batch(self, batch):
         batch = self._place_batch(batch)

@@ -1,0 +1,66 @@
+"""TensorBoard scalar monitor.
+
+Capability parity with the reference engine's summary-writer integration
+(/root/reference/deepspeed/runtime/engine.py:163 builds a SummaryWriter from
+the `tensorboard` config block; :1058,1223 write Train/Samples/train_loss,
+lr, loss_scale per step). Uses torch.utils.tensorboard when available and
+falls back to a JSONL event log with the same tag/step/value records so
+headless TPU pods still get machine-readable scalars.
+"""
+
+import json
+import os
+import time
+from typing import Optional
+
+from .logging import logger
+
+
+class TensorBoardMonitor:
+    def __init__(self, output_path: str = "", job_name: str = "DeepSpeedJobName",
+                 enabled: bool = True):
+        self.enabled = enabled
+        self._writer = None
+        self._jsonl = None
+        if not enabled:
+            return
+        base = os.path.join(output_path or "runs", job_name)
+        os.makedirs(base, exist_ok=True)
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+
+            self._writer = SummaryWriter(log_dir=base)
+        except Exception as e:  # pragma: no cover - env without tensorboard
+            path = os.path.join(base, "events.jsonl")
+            logger.warning(
+                "tensorboard unavailable (%s); writing JSONL scalars to %s",
+                e, path,
+            )
+            self._jsonl = open(path, "a")
+
+    def add_scalar(self, tag: str, value, global_step: int):
+        if not self.enabled:
+            return
+        value = float(value)
+        if self._writer is not None:
+            self._writer.add_scalar(tag, value, global_step)
+        elif self._jsonl is not None:
+            self._jsonl.write(json.dumps(
+                {"tag": tag, "value": value, "step": int(global_step),
+                 "ts": time.time()}) + "\n")
+
+    def write_scalars(self, scalars: dict, global_step: int):
+        for tag, value in scalars.items():
+            self.add_scalar(tag, value, global_step)
+
+    def flush(self):
+        if self._writer is not None:
+            self._writer.flush()
+        if self._jsonl is not None:
+            self._jsonl.flush()
+
+    def close(self):
+        if self._writer is not None:
+            self._writer.close()
+        if self._jsonl is not None:
+            self._jsonl.close()

@@ -1,0 +1,140 @@
+"""Flops profiler tests (reference tests/unit/test_flops_profiler.py analog:
+profiled flops of a known model must match the hand-computed count)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deeperspeed_tpu as deepspeed
+from deeperspeed_tpu.profiling.flops_profiler import (
+    FlopsProfiler,
+    flops_to_string,
+    get_model_profile,
+    macs_to_string,
+    params_to_string,
+)
+from deeperspeed_tpu.profiling.flops_profiler.profiler import flops_of_jaxpr
+
+
+def _mlp(params, x):
+    h = jnp.tanh(x @ params["w1"])
+    return h @ params["w2"]
+
+
+def _params():
+    return {
+        "w1": jnp.ones((64, 128), jnp.float32),
+        "w2": jnp.ones((128, 16), jnp.float32),
+    }
+
+
+def test_jaxpr_flop_walk_counts_matmuls():
+    params, x = _params(), jnp.ones((8, 64))
+    counts = flops_of_jaxpr(jax.make_jaxpr(_mlp)(params, x))
+    # two dot_generals: 2*8*64*128 + 2*8*128*16
+    assert counts["dot_general"] == 2 * 8 * 64 * 128 + 2 * 8 * 128 * 16
+    assert counts["tanh"] == 8 * 128 * 10
+
+
+def test_jaxpr_flop_walk_scales_scan_by_length():
+    def scanned(w, x):
+        def body(h, _):
+            return jnp.tanh(h @ w), None
+        h, _ = jax.lax.scan(body, x, None, length=5)
+        return h
+
+    w, x = jnp.ones((32, 32)), jnp.ones((4, 32))
+    counts = flops_of_jaxpr(jax.make_jaxpr(scanned)(w, x))
+    assert counts["dot_general"] == 5 * 2 * 4 * 32 * 32
+
+
+def test_profiler_totals_and_strings():
+    params, x = _params(), jnp.ones((8, 64))
+    prof = FlopsProfiler(_mlp)
+    prof.start_profile(params, x)
+    flops = prof.get_total_flops()
+    assert flops >= 2 * 8 * 64 * 128  # at least the first matmul
+    assert prof.get_total_params() == 64 * 128 + 128 * 16
+    assert prof.get_total_macs() == (2 * 8 * 64 * 128 + 2 * 8 * 128 * 16) // 2
+    assert prof.get_total_duration() > 0
+    report = prof.print_model_profile(profile_step=3)
+    assert "dot_general" in report and "profile step" in report
+    prof.end_profile()
+
+
+def test_get_model_profile_entry_point():
+    params, x = _params(), jnp.ones((2, 64))
+    flops, macs, nparams = get_model_profile(
+        _mlp, args=(params, x), print_profile=False, as_string=False
+    )
+    assert flops > 0 and macs > 0
+    assert nparams == 64 * 128 + 128 * 16
+    s_flops, s_macs, s_params = get_model_profile(
+        _mlp, args=(params, x), print_profile=False, as_string=True
+    )
+    assert s_flops.endswith("FLOPS") and s_macs.endswith("MACs")
+
+
+def test_unit_strings():
+    assert flops_to_string(2.5e12) == "2.50 TFLOPS"
+    assert flops_to_string(1.5e9) == "1.50 GFLOPS"
+    assert macs_to_string(3e6) == "3.00 MMACs"
+    assert params_to_string(125_000) == "125.00 K"
+
+
+def test_engine_imperative_path_profiles(tmp_path):
+    out_file = str(tmp_path / "prof_imperative.txt")
+
+    def loss_fn(params, batch):
+        x, y = batch
+        return jnp.mean((x @ params["w"] - y) ** 2)
+
+    engine, _, _, _ = deepspeed.initialize(
+        model=loss_fn,
+        model_parameters={"w": jnp.zeros((8, 2))},
+        config_params={
+            "train_batch_size": 8,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "flops_profiler": {
+                "enabled": True, "profile_step": 1, "output_file": out_file,
+            },
+        },
+    )
+    x = np.random.RandomState(0).randn(8, 8).astype(np.float32)
+    y = np.random.RandomState(1).randn(8, 2).astype(np.float32)
+    loss = engine((jnp.asarray(x), jnp.asarray(y)))  # forward
+    engine.backward(loss)
+    engine.step()
+    assert os.path.exists(out_file)
+
+
+def test_engine_profile_step_writes_report(tmp_path):
+    out_file = str(tmp_path / "profile.txt")
+
+    def loss_fn(params, batch):
+        x, y = batch
+        return jnp.mean((x @ params["w"] - y) ** 2)
+
+    engine, _, _, _ = deepspeed.initialize(
+        model=loss_fn,
+        model_parameters={"w": jnp.zeros((16, 4))},
+        config_params={
+            "train_batch_size": 8,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "flops_profiler": {
+                "enabled": True,
+                "profile_step": 2,
+                "output_file": out_file,
+            },
+        },
+    )
+    x = np.random.RandomState(0).randn(8, 16).astype(np.float32)
+    y = np.random.RandomState(1).randn(8, 4).astype(np.float32)
+    for _ in range(3):
+        engine.train_batch(batch=(jnp.asarray(x), jnp.asarray(y)))
+    with open(out_file) as f:
+        report = f.read()
+    assert "Flops Profiler" in report and "dot_general" in report

@@ -1,0 +1,56 @@
+"""TensorBoard monitor tests (reference engine tensorboard integration)."""
+
+import glob
+import os
+
+import jax.numpy as jnp
+import numpy as np
+
+import deeperspeed_tpu as deepspeed
+from deeperspeed_tpu.utils.tensorboard import TensorBoardMonitor
+
+
+def test_monitor_writes_events(tmp_path):
+    mon = TensorBoardMonitor(output_path=str(tmp_path), job_name="job")
+    mon.add_scalar("Train/Samples/train_loss", 1.25, 10)
+    mon.write_scalars({"Train/Samples/lr": 1e-3}, 20)
+    mon.flush()
+    mon.close()
+    files = glob.glob(str(tmp_path / "job" / "*"))
+    assert files, "no event files written"
+
+
+def test_monitor_disabled_is_noop(tmp_path):
+    mon = TensorBoardMonitor(output_path=str(tmp_path), job_name="off",
+                             enabled=False)
+    mon.add_scalar("x", 1.0, 0)
+    mon.flush()
+    mon.close()
+    assert not os.path.exists(tmp_path / "off")
+
+
+def test_engine_writes_tensorboard_scalars(tmp_path):
+    def loss_fn(params, batch):
+        x, y = batch
+        return jnp.mean((x @ params["w"] - y) ** 2)
+
+    engine, _, _, _ = deepspeed.initialize(
+        model=loss_fn,
+        model_parameters={"w": jnp.zeros((8, 2))},
+        config_params={
+            "train_batch_size": 8,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "tensorboard": {
+                "enabled": True,
+                "output_path": str(tmp_path),
+                "job_name": "unit",
+            },
+        },
+    )
+    assert engine.summary_writer is not None
+    x = np.random.RandomState(0).randn(8, 8).astype(np.float32)
+    y = np.random.RandomState(1).randn(8, 2).astype(np.float32)
+    for _ in range(3):
+        engine.train_batch(batch=(jnp.asarray(x), jnp.asarray(y)))
+    files = glob.glob(str(tmp_path / "unit" / "*"))
+    assert files, "engine wrote no tensorboard events"
