@@ -31,6 +31,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..parallel.topology import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
+from ..utils import hooks
 
 
 @dataclasses.dataclass(frozen=True)
@@ -328,10 +329,16 @@ def make_gpt(cfg: GPTConfig, mesh=None):
         if cfg.remat:
             step = jax.checkpoint(step, prevent_cse=False)
 
-        def scan_body(carry, layer_params):
-            return step(carry, layer_params), None
+        def scan_body(carry, xs):
+            layer_params, layer_idx = xs
+            out = step(carry, layer_params)
+            # cooperative layer-output tap (engine.register_forward_hook);
+            # identity unless a collector is active at trace time
+            out = hooks.record_layer_output("transformerlayer", out, layer_idx)
+            return out, None
 
-        x, _ = jax.lax.scan(scan_body, x, params["layers"])
+        layer_ids = jnp.arange(cfg.n_layer, dtype=jnp.int32)
+        x, _ = jax.lax.scan(scan_body, x, (params["layers"], layer_ids))
         x = layer_norm(
             x, params["final_ln"]["scale"], params["final_ln"]["bias"], cfg.layernorm_eps
         )

@@ -15,3 +15,4 @@ from .kernels import (
     make_block_sparse_attention,
 )
 from .sparse_self_attention import BertSparseSelfAttention, SparseSelfAttention
+from .sparse_attention_utils import SparseAttentionUtils

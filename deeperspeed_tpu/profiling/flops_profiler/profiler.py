@@ -258,8 +258,9 @@ def get_model_profile(model: Callable, args=(), kwargs=None,
     (flops, macs, params) for one forward of ``model(*args)``."""
     kwargs = kwargs or {}
     prof = FlopsProfiler(model)
+    jitted = jax.jit(model)
     for _ in range(max(warm_up - 1, 0)):
-        jax.block_until_ready(jax.jit(model)(*args, **kwargs))
+        jax.block_until_ready(jitted(*args, **kwargs))
     prof.start_profile(*args, **kwargs)
     if print_profile:
         prof.print_model_profile()

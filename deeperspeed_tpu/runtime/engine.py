@@ -142,6 +142,13 @@ class Engine:
                 job_name=config.tensorboard_job_name,
             )
 
+        # fork extras (reference engine.py:139,227): gradient stashing and
+        # layer-output capture
+        self.store_gradients = False
+        self.store_gradients_cpu = False
+        self.stored_gradients = None
+        self._layer_collector = None
+
         self.global_steps = 0
         self.global_samples = 0
         self.micro_steps = 0
@@ -681,7 +688,10 @@ class Engine:
         if self._mode != "train":
             return self._forward_only_fn()(self.state, self._pack_pld(batch, 1.0), rng)
         batch = self._pack_pld(batch)
-        if self._config.flops_profiler_config.enabled:
+        if self._layer_collector is not None and self._acc_count == 0:
+            self._layer_collector.clear()  # fresh capture per accumulation cycle
+        fpc = self._config.flops_profiler_config
+        if fpc.enabled and not getattr(self, "_flops_profiled", False):
             self._profile_args = (batch, rng)
         loss, grads = self._forward_grad_fn()(self.state, batch, rng)
         self._stashed = (loss, grads)
@@ -720,6 +730,8 @@ class Engine:
                     self.state, self._grad_acc, lr, jnp.float32(self._acc_count)
                 )
                 self.state = new_state
+            if self.store_gradients:
+                self._store_grads(self._grad_acc)
             self._grad_acc = None
             self._acc_count = 0
             self._after_optimizer_step(metrics)
@@ -737,18 +749,16 @@ class Engine:
         if self.progressive_layer_drop is not None:
             self.progressive_layer_drop.update_state(self.global_steps)
         if self.summary_writer is not None:
-            scalars = {"Train/Samples/lr": self._current_lr()}
-            loss = metrics.get("loss")
-            if loss is None:  # imperative path: last microbatch's loss
-                loss = getattr(self, "_last_micro_loss", None)
-            if loss is not None:
-                scalars["Train/Samples/train_loss"] = jax.device_get(loss)
-            if self._loss_scaler.dynamic:
-                scalars["Train/Samples/loss_scale"] = jax.device_get(
-                    metrics["loss_scale"]
-                )
-            self.summary_writer.write_scalars(scalars, self.global_samples)
-            self.summary_writer.flush()
+            # write the PREVIOUS step's scalars (its device values have
+            # completed, so device_get doesn't stall the pipeline — keeps
+            # the async hot-path guarantee below)
+            self._tb_write_pending()
+            tb_metrics = dict(metrics)
+            micro_loss = getattr(self, "_last_micro_loss", None)
+            if micro_loss is not None:
+                tb_metrics.setdefault("_micro_loss", micro_loss)
+            self._tb_pending = (tb_metrics, self._current_lr(),
+                                self.global_samples)
         self._pending_metrics = metrics
         if self._loss_scaler.dynamic:
             overflow = bool(jax.device_get(metrics["overflow"]))
@@ -776,11 +786,24 @@ class Engine:
         rng, self.rng = _split(self.rng)
         lr = jnp.float32(self._current_lr())
         self.tput_timer.start()
+        if self._layer_collector is not None:
+            self._layer_collector.clear()
         if self._offload is not None:
             loss, grads, gnorm, finite = self._offload_grads_fn()(
                 self.state, batch, rng
             )
             metrics = self._offload_apply(grads, gnorm, finite, loss)
+        elif self.store_gradients:
+            # unfused route so the grads are observable (reference
+            # engine.py:1156 clones p.grad at step time)
+            loss, grads = self._batch_grads_fn()(self.state, batch, rng)
+            self._store_grads(grads)
+            new_state, metrics = self._apply_update_fn()(
+                self.state, grads, lr,
+                jnp.float32(self.gradient_accumulation_steps()),
+            )
+            metrics = dict(metrics, loss=loss)
+            self.state = new_state
         else:
             new_state, metrics = self._train_batch_fn()(self.state, batch, lr, rng)
             self.state = new_state
@@ -790,12 +813,87 @@ class Engine:
         self._maybe_profile_flops(batch, rng)
         return metrics["loss"]
 
+    # ------------------------------------------------------------------ #
+    # fork extras: layer-output hooks + gradient stashing
+    # ------------------------------------------------------------------ #
+
+    def register_forward_hook(self, layers_to_hook="all",
+                              layer_name_pattern=None):
+        """Capture layer outputs tapped via utils.hooks.record_layer_output
+        (reference engine.py:227 torch forward hooks). Forces a retrace so
+        the taps lower into the compiled step."""
+        from ..utils import hooks
+
+        self._layer_collector = hooks.LayerOutputCollector(
+            layers_to_hook, layer_name_pattern
+        )
+        hooks.set_active(self._layer_collector)
+        self._compiled.clear()
+
+    def remove_forward_hooks(self):
+        from ..utils import hooks
+
+        hooks.set_active(None)
+        self._layer_collector = None
+        self._compiled.clear()
+
+    @property
+    def layer_outputs(self):
+        if self._layer_collector is None:
+            return {}
+        jax.effects_barrier()  # flush pending tap callbacks
+        return self._layer_collector.layer_outputs
+
+    def _store_grads(self, grads):
+        if self.store_gradients_cpu:
+            self.stored_gradients = jax.tree.map(
+                lambda g: np.asarray(jax.device_get(g)), grads
+            )
+        else:
+            self.stored_gradients = grads
+
+    def _batch_grads_fn(self):
+        """jitted (state, batch, rng) -> (loss, summed grads over gas)."""
+
+        def build():
+            gas = self.gradient_accumulation_steps()
+
+            def fn(state, batch, rng):
+                return self._batch_grads(state, batch, rng, gas)
+
+            return jax.jit(fn)
+
+        return self._get_compiled("batch_grads", build)
+
+    def _tb_write_pending(self):
+        """Emit the previous step's tensorboard scalars (now settled on
+        device). Called on the next boundary and before checkpoints."""
+        pending = getattr(self, "_tb_pending", None)
+        if self.summary_writer is None or pending is None:
+            return
+        self._tb_pending = None
+        metrics_prev, lr_prev, samples_prev = pending
+        scalars = {"Train/Samples/lr": lr_prev}
+        loss = metrics_prev.get("loss")
+        if loss is None:  # imperative path: last microbatch's loss
+            loss = metrics_prev.get("_micro_loss")
+        if loss is not None:
+            scalars["Train/Samples/train_loss"] = jax.device_get(loss)
+        if self._loss_scaler.dynamic:
+            scalars["Train/Samples/loss_scale"] = jax.device_get(
+                metrics_prev["loss_scale"]
+            )
+        self.summary_writer.write_scalars(scalars, samples_prev)
+        self.summary_writer.flush()
+
     def _maybe_profile_flops(self, batch, rng):
         """One-shot flops profile at profile_step (reference engine.py:966-1019
         triggers the profiler inside forward at that step)."""
         fpc = self._config.flops_profiler_config
         if not fpc.enabled or self.global_steps != fpc.profile_step:
             return
+        self._flops_profiled = True  # one-shot: stop stashing batches
+        self._profile_args = None
         from ..profiling.flops_profiler import FlopsProfiler
 
         def fwd(params, batch, rng):
@@ -836,6 +934,7 @@ class Engine:
         return jax.jit(lambda t: t, out_shardings=reps)(tree)
 
     def save_checkpoint(self, save_dir, tag=None, client_state=None, save_latest=True):
+        self._tb_write_pending()
         if tag is None:
             tag = f"global_step{self.global_steps}"
         tag = str(tag)

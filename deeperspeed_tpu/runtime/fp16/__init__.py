@@ -4,3 +4,4 @@ from .loss_scaler import (
     LossScaleState,
     create_loss_scaler,
 )
+from .fused_optimizer import FP16_Optimizer, FP16_UnfusedOptimizer

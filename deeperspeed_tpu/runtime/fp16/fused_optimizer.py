@@ -1,0 +1,139 @@
+"""Standalone mixed-precision optimizer wrappers.
+
+Capability parity with /root/reference/deepspeed/runtime/fp16/
+fused_optimizer.py:51 (`FP16_Optimizer`) and unfused_optimizer.py
+(`FP16_UnfusedOptimizer`): fp32 master weights + (dynamic) loss scaling +
+global-norm clipping around an inner optimizer, usable WITHOUT the engine
+(the engine fuses the same numerics into its jitted step; these wrappers
+serve callers that drive the optimizer directly, e.g. ports of reference
+training scripts).
+
+The fused/unfused distinction in the reference is flat-buffer vs per-tensor
+master storage — a memory-layout concern XLA owns — so both classes share
+one implementation here; `FP16_UnfusedOptimizer` keeps the per-group
+clipping semantics LAMB needs (norm per tensor, not global).
+
+On TPU "fp16" compute defaults to bfloat16.
+"""
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...utils.logging import logger
+from ..utils import CheckOverflow, clip_by_global_norm, global_norm
+from .loss_scaler import DynamicLossScaler, StaticLossScaler
+
+
+class FP16_Optimizer:
+    """Reference fused_optimizer.py:51. Wraps a functional optimizer
+    (init/update) with master weights + loss scaling.
+
+    Usage::
+
+        opt = FP16_Optimizer(FusedAdam(lr=1e-3), init_params,
+                             dynamic_loss_scale=True)
+        scaled_loss = opt.scale_loss(loss)        # inside grad fn
+        overflow = opt.step(scaled_grads)         # grads of the SCALED loss
+        half_params = opt.params                  # refreshed compute copy
+    """
+
+    per_tensor_clip = False
+
+    def __init__(self, optimizer, init_params, static_loss_scale: float = 1.0,
+                 dynamic_loss_scale: bool = False, dynamic_loss_args: Optional[dict] = None,
+                 clip_grad: float = 0.0, compute_dtype=jnp.bfloat16,
+                 verbose: bool = True):
+        self.optimizer = optimizer
+        self.clip_grad = clip_grad
+        self.compute_dtype = compute_dtype
+        self.fp32_params = jax.tree.map(
+            lambda p: p.astype(jnp.float32), init_params
+        )
+        self.opt_state = optimizer.init(self.fp32_params)
+        if dynamic_loss_scale:
+            self.loss_scaler = DynamicLossScaler(**(dynamic_loss_args or {}))
+        else:
+            self.loss_scaler = StaticLossScaler(scale=static_loss_scale)
+        self.scaler_state = self.loss_scaler.init()
+        self.overflow = False
+        self._refresh_half()
+        if verbose:
+            logger.info("FP16_Optimizer: loss scale %s, clip %s",
+                        self.cur_scale, clip_grad)
+
+    # ------------------------------------------------------------------ #
+
+    @property
+    def cur_scale(self):
+        return float(jax.device_get(self.scaler_state.loss_scale))
+
+    @property
+    def params(self):
+        return self._half_params
+
+    def _refresh_half(self):
+        self._half_params = jax.tree.map(
+            lambda p: p.astype(self.compute_dtype), self.fp32_params
+        )
+
+    def scale_loss(self, loss):
+        """Multiply the loss by the current scale (reference backward())."""
+        return loss * self.scaler_state.loss_scale.astype(loss.dtype)
+
+    backward = scale_loss  # reference API name
+
+    def _clip(self, grads):
+        if not self.clip_grad:
+            return grads, global_norm(grads)
+        if self.per_tensor_clip:
+            def clip_one(g):
+                clipped, _ = clip_by_global_norm({"g": g}, self.clip_grad)
+                return clipped["g"]
+            return jax.tree.map(clip_one, grads), global_norm(grads)
+        return clip_by_global_norm(grads, self.clip_grad)
+
+    def step(self, grads) -> bool:
+        """Unscale + overflow-check + clip + inner update + refresh half
+        copy. Returns True when the step was SKIPPED on overflow."""
+        scale = self.scaler_state.loss_scale
+        grads32 = jax.tree.map(lambda g: g.astype(jnp.float32) / scale, grads)
+        overflow = bool(jax.device_get(CheckOverflow.has_overflow_serial(grads32)))
+        self.scaler_state = self.loss_scaler.update(self.scaler_state,
+                                                    jnp.asarray(overflow))
+        self.overflow = overflow
+        if overflow:
+            logger.info("FP16_Optimizer overflow: skipping step; "
+                        "loss scale -> %s", self.cur_scale)
+            return True
+        grads32, self._last_norm = self._clip(grads32)
+        self.fp32_params, self.opt_state = self.optimizer.update(
+            grads32, self.opt_state, self.fp32_params
+        )
+        self._refresh_half()
+        return False
+
+    # checkpointing -------------------------------------------------------
+
+    def state_dict(self) -> dict:
+        return {
+            "fp32_params": self.fp32_params,
+            "opt_state": self.opt_state,
+            "scaler_state": self.scaler_state,
+            "overflow": self.overflow,
+        }
+
+    def load_state_dict(self, sd: dict):
+        self.fp32_params = sd["fp32_params"]
+        self.opt_state = sd["opt_state"]
+        self.scaler_state = sd["scaler_state"]
+        self.overflow = sd.get("overflow", False)
+        self._refresh_half()
+
+
+class FP16_UnfusedOptimizer(FP16_Optimizer):
+    """Reference unfused_optimizer.py: per-tensor master weights + per-tensor
+    clipping (the layout LAMB's per-layer norms require)."""
+
+    per_tensor_clip = True

@@ -235,3 +235,47 @@ class PartitionedTensor:
     def from_parts(meta: Dict[str, Any], parts: Sequence[np.ndarray]) -> np.ndarray:
         flat = np.concatenate(parts)[: meta["orig_size"]]
         return flat.reshape(meta["orig_shape"])
+
+
+class CheckOverflow:
+    """Overflow detector over gradient pytrees (reference runtime/utils.py
+    `CheckOverflow`): a single fused finiteness reduction, with the result
+    combined across the mesh when called inside shard_map (the analog of the
+    reference's allreduce of the overflow flag across DP/MP ranks)."""
+
+    def __init__(self, param_groups=None, mpu=None):
+        self.mpu = mpu
+        self.params = param_groups
+
+    @staticmethod
+    def has_overflow_serial(grads) -> jnp.ndarray:
+        flag = jnp.zeros((), bool)
+        for g in jax.tree.leaves(grads):
+            leaf_bad = jnp.logical_not(jnp.all(jnp.isfinite(g.astype(jnp.float32))))
+            flag = jnp.logical_or(flag, leaf_bad)
+        return flag
+
+    def check(self, grads, axis_names: Sequence[str] = ()) -> jnp.ndarray:
+        """Traced: bool scalar. Pass the mesh axis names when tracing inside
+        shard_map so every shard agrees (psum-of-flags)."""
+        flag = self.has_overflow_serial(grads)
+        for ax in axis_names:
+            flag = jax.lax.psum(flag.astype(jnp.int32), ax) > 0
+        return flag
+
+    def has_overflow(self, grads) -> bool:
+        """Host-side convenience: concrete bool."""
+        return bool(jax.device_get(self.has_overflow_serial(grads)))
+
+
+def mem_status(msg: str, print_rank: int = -1, reset_max: bool = False):
+    """Reference pipe/engine.py:1197 mem_status: log memory via
+    see_memory_usage, gated to ``print_rank`` (-1 = every process). XLA
+    exposes no peak-counter reset, so reset_max logs a debug note."""
+    if print_rank >= 0 and jax.process_index() != print_rank:
+        return memory_status()
+    if reset_max:
+        logger.debug("mem_status(reset_max=True): XLA has no peak reset; "
+                     "peak is cumulative for the process")
+    see_memory_usage(f"MEM {msg}", force=True)
+    return memory_status()

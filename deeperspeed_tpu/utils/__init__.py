@@ -1,3 +1,5 @@
 from .logging import logger, log_dist, LoggerFactory
 from .timer import SynchronizedWallClockTimer, ThroughputTimer
 from .distributed import init_distributed, mpi_discovery
+from .hooks import LayerOutputCollector, record_layer_output
+from .tensorboard import TensorBoardMonitor

@@ -1,0 +1,91 @@
+"""Layer-output capture (fork extra; reference engine.register_forward_hook
+/root/reference/deepspeed/runtime/engine.py:227).
+
+The reference hangs torch forward hooks on modules matching a name pattern
+and stashes their outputs (moved to CPU) in ``engine.layer_outputs`` — used
+by GPT-NeoX for logit-lens style inspection.
+
+TPU design: functional models have no modules to hook, so capture is a
+cooperative tap — models call ``record_layer_output(key, value)`` at the
+points they want observable (models/gpt.py calls it per decoder layer).
+When no capture is active the tap is an identity at TRACE time (zero cost in
+the compiled program). When the engine enables capture it re-traces the
+step, and each tap lowers to an io_callback that copies the value to host
+into the active collector, exactly the `.cpu()` stash the reference does.
+"""
+
+from typing import Any, Dict, List, Optional, Union
+
+import jax
+import numpy as np
+
+_ACTIVE: Optional["LayerOutputCollector"] = None
+
+
+class LayerOutputCollector:
+    """Holds captured outputs: key -> list of host arrays (one per call).
+    ``layer_name_pattern`` additionally filters string keys, mirroring the
+    reference's regex module-name filter."""
+
+    def __init__(self, layers_to_hook: Union[str, List] = "all",
+                 layer_name_pattern: Optional[str] = None):
+        import re
+
+        self.layers_to_hook = layers_to_hook
+        self.pattern = re.compile(layer_name_pattern, re.IGNORECASE) \
+            if layer_name_pattern else None
+        self.layer_outputs: Dict[Any, list] = {}
+
+    def wants(self, key) -> bool:
+        if self.pattern is not None and isinstance(key, str) \
+                and not self.pattern.search(key):
+            return False
+        if self.layers_to_hook == "all":
+            return True
+        return key in self.layers_to_hook
+
+    def _store(self, key, value, index=None):
+        lst = self.layer_outputs.setdefault(key, [])
+        if index is None:
+            lst.append(np.asarray(value))
+            return
+        i = int(index)
+        while len(lst) <= i:
+            lst.append(None)
+        lst[i] = np.asarray(value)
+
+    def clear(self):
+        self.layer_outputs = {}
+
+
+def capture_active() -> bool:
+    return _ACTIVE is not None
+
+
+def set_active(collector: Optional[LayerOutputCollector]):
+    global _ACTIVE
+    _ACTIVE = collector
+
+
+def record_layer_output(key, value, index=None):
+    """Tap point for models. Returns ``value`` unchanged; when a collector
+    is active at trace time, also emits a host copy of it. Uses
+    jax.debug.callback, which stays legal under grad/vmap/scan (io_callback
+    does not differentiate).
+
+    The callbacks are UNORDERED (ordered effects don't lower multi-device),
+    so pass ``index`` — a traced layer counter, e.g. the scan iteration —
+    to place each capture at its layer's slot regardless of host arrival
+    order. Without an index, entries land in arrival order."""
+    if _ACTIVE is None or not _ACTIVE.wants(key):
+        return value
+    collector = _ACTIVE
+
+    def cb(v, i=None):
+        collector._store(key, v, i)
+
+    if index is None:
+        jax.debug.callback(cb, value)
+    else:
+        jax.debug.callback(cb, value, index)
+    return value

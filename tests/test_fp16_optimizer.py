@@ -1,0 +1,135 @@
+"""Standalone FP16_Optimizer / FP16_UnfusedOptimizer wrapper tests
+(reference tests/unit/test_fp16.py wrapper-level cases) + CheckOverflow +
+hooks + store_gradients fork extras."""
+
+import glob
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deeperspeed_tpu as deepspeed
+from deeperspeed_tpu.ops import FusedAdam
+from deeperspeed_tpu.runtime.fp16 import FP16_Optimizer, FP16_UnfusedOptimizer
+from deeperspeed_tpu.runtime.utils import CheckOverflow
+from deeperspeed_tpu.utils import hooks
+
+
+def _quad_problem():
+    params = {"w": jnp.ones((8, 4), jnp.float32)}
+    x = jax.random.normal(jax.random.PRNGKey(0), (16, 8))
+    y = jax.random.normal(jax.random.PRNGKey(1), (16, 4))
+
+    def loss(p, opt):
+        half = jax.tree.map(lambda q: q.astype(opt.compute_dtype), p)
+        l = jnp.mean((x.astype(opt.compute_dtype) @ half["w"] - y.astype(opt.compute_dtype)) ** 2)
+        return opt.scale_loss(l.astype(jnp.float32))
+
+    return params, loss
+
+
+@pytest.mark.parametrize("cls", [FP16_Optimizer, FP16_UnfusedOptimizer])
+def test_fp16_optimizer_converges(cls):
+    params, scaled_loss = _quad_problem()
+    opt = cls(FusedAdam(lr=5e-2), params, dynamic_loss_scale=True,
+              clip_grad=1.0, verbose=False)
+    l0 = None
+    for i in range(40):
+        grads = jax.grad(scaled_loss)(opt.fp32_params, opt)
+        skipped = opt.step(grads)
+        assert not skipped
+        if l0 is None:
+            l0 = float(scaled_loss(opt.fp32_params, opt) / opt.cur_scale)
+    l1 = float(scaled_loss(opt.fp32_params, opt) / opt.cur_scale)
+    assert l1 < l0 / 2
+
+
+def test_fp16_optimizer_overflow_skips_and_shrinks_scale():
+    params, _ = _quad_problem()
+    opt = FP16_Optimizer(FusedAdam(lr=1e-2), params,
+                         dynamic_loss_scale=True,
+                         dynamic_loss_args={"init_scale": 2 ** 16},
+                         verbose=False)
+    before = jax.tree.map(np.asarray, opt.fp32_params)
+    bad = {"w": jnp.full((8, 4), jnp.inf)}
+    skipped = opt.step(bad)
+    assert skipped and opt.overflow
+    assert opt.cur_scale < 2 ** 16  # halved
+    after = opt.fp32_params
+    np.testing.assert_allclose(np.asarray(after["w"]), before["w"])  # untouched
+
+
+def test_fp16_optimizer_state_round_trip():
+    params, scaled_loss = _quad_problem()
+    opt = FP16_Optimizer(FusedAdam(lr=1e-2), params, verbose=False)
+    grads = jax.grad(scaled_loss)(opt.fp32_params, opt)
+    opt.step(grads)
+    sd = opt.state_dict()
+    opt2 = FP16_Optimizer(FusedAdam(lr=1e-2), params, verbose=False)
+    opt2.load_state_dict(sd)
+    np.testing.assert_allclose(np.asarray(opt2.fp32_params["w"]),
+                               np.asarray(opt.fp32_params["w"]))
+    assert opt2.params["w"].dtype == jnp.bfloat16
+
+
+def test_check_overflow():
+    co = CheckOverflow()
+    good = {"a": jnp.ones((4,)), "b": jnp.zeros((2, 2))}
+    bad = {"a": jnp.ones((4,)), "b": jnp.asarray([[1.0, jnp.nan], [0, 0]])}
+    assert not co.has_overflow(good)
+    assert co.has_overflow(bad)
+    assert bool(jax.jit(co.has_overflow_serial)(bad))
+
+
+def test_engine_store_gradients():
+    def loss_fn(params, batch):
+        x, y = batch
+        return jnp.mean((x @ params["w"] - y) ** 2)
+
+    engine, _, _, _ = deepspeed.initialize(
+        model=loss_fn, model_parameters={"w": jnp.zeros((8, 2))},
+        config_params={"train_batch_size": 8,
+                       "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}},
+    )
+    engine.store_gradients = True
+    engine.store_gradients_cpu = True
+    x = np.random.RandomState(0).randn(8, 8).astype(np.float32)
+    y = np.random.RandomState(1).randn(8, 2).astype(np.float32)
+    engine.train_batch(batch=(jnp.asarray(x), jnp.asarray(y)))
+    assert engine.stored_gradients is not None
+    g = engine.stored_gradients["w"]
+    assert isinstance(g, np.ndarray)
+    # matches the analytic gradient of the MSE at w=0
+    expect = -2.0 * x.T @ y / (8 * 2)
+    np.testing.assert_allclose(g, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_engine_layer_output_hooks():
+    from deeperspeed_tpu.models.gpt import GPTConfig, make_gpt
+
+    cfg = GPTConfig(vocab_size=64, n_layer=3, n_head=2, d_model=32,
+                    max_seq=16, remat=False, dtype=jnp.float32)
+    init_fn, apply_fn, loss_fn, _ = make_gpt(cfg)
+    params = init_fn(jax.random.PRNGKey(0))
+
+    engine, _, _, _ = deepspeed.initialize(
+        model=loss_fn, model_parameters=params,
+        config_params={"train_batch_size": 8,
+                       "optimizer": {"type": "Adam", "params": {"lr": 1e-4}}},
+    )
+    engine.register_forward_hook(layers_to_hook="all")
+    toks = np.random.RandomState(0).randint(0, 64, (8, 17)).astype(np.int32)
+    engine.train_batch(batch=jnp.asarray(toks))
+    outs = engine.layer_outputs
+    assert "transformerlayer" in outs
+    assert len(outs["transformerlayer"]) == 3  # one per scanned layer
+    assert outs["transformerlayer"][0].shape == (8, 16, 32)
+    engine.remove_forward_hooks()
+    assert not hooks.capture_active()
+
+
+def test_hook_pattern_filter():
+    collector = hooks.LayerOutputCollector("all", layer_name_pattern="attn")
+    assert collector.wants("attn_out")
+    assert not collector.wants("mlp_out")

@@ -1,0 +1,117 @@
+"""SparseAttentionUtils + ds_elastic CLI tests (reference
+sparse_attention_utils.py and bin/ds_elastic analogs)."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeperspeed_tpu.ops.sparse_attention import (
+    FixedSparsityConfig,
+    SparseAttentionUtils,
+)
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+from transformers.models.bert.configuration_bert import BertConfig
+from transformers.models.bert.modeling_bert import BertModel
+
+
+def _bert(hidden=32, heads=4, layers=2, max_pos=64):
+    cfg = BertConfig(
+        hidden_size=hidden, num_attention_heads=heads,
+        intermediate_size=hidden * 4, num_hidden_layers=layers,
+        max_position_embeddings=max_pos,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+    )
+    torch.manual_seed(0)
+    return cfg, BertModel(cfg).eval()
+
+
+def test_extend_position_embedding_tiles():
+    emb = np.random.RandomState(0).randn(8, 4).astype(np.float32)
+    out = SparseAttentionUtils.extend_position_embedding(emb, 20)
+    assert out.shape == (20, 4)
+    np.testing.assert_allclose(np.asarray(out[:8]), emb)
+    np.testing.assert_allclose(np.asarray(out[8:16]), emb)
+    # shrink path
+    out2 = SparseAttentionUtils.extend_position_embedding(emb, 4)
+    assert out2.shape == (4, 4)
+
+
+def test_replace_model_self_attention():
+    cfg, model = _bert()
+    layer, params_list = (
+        SparseAttentionUtils
+        .replace_model_self_attention_with_sparse_self_attention(
+            model, max_position=64,
+            sparsity_config=FixedSparsityConfig(num_heads=4, block=16),
+        )
+    )
+    assert len(params_list) == cfg.num_hidden_layers
+    # extracted q projection must match the torch layer's weights
+    qw = model.encoder.layer[0].attention.self.query.weight.detach().numpy()
+    np.testing.assert_allclose(
+        np.asarray(params_list[0]["query"]["w"]), qw.T, rtol=1e-6
+    )
+    # and the sparse layer must run with them
+    h = jnp.asarray(np.random.RandomState(0).randn(2, 64, 32).astype(np.float32))
+    out = layer.apply(params_list[0], h)
+    assert out.shape == (2, 64, 32)
+
+
+def test_pad_to_block_size_and_unpad():
+    ids = jnp.asarray(np.arange(2 * 30).reshape(2, 30) % 7)
+    mask = jnp.ones((2, 30), jnp.float32)
+    pad_len, pids, pmask, ptt, ppos, pemb = SparseAttentionUtils.pad_to_block_size(
+        block_size=16, input_ids=ids, attention_mask=mask, pad_token_id=99
+    )
+    assert pad_len == 2
+    assert pids.shape == (2, 32) and int(pids[0, -1]) == 99
+    assert pmask.shape == (2, 32) and float(pmask[0, -1]) == 0.0
+    assert ptt is None and ppos is None and pemb is None
+
+    seq_out = jnp.ones((2, 32, 8))
+    unpadded = SparseAttentionUtils.unpad_sequence_output(pad_len, seq_out)
+    assert unpadded.shape == (2, 30, 8)
+    # already-aligned input: no-op
+    pad_len2, pids2, *_ = SparseAttentionUtils.pad_to_block_size(
+        block_size=16, input_ids=jnp.ones((1, 32), jnp.int32)
+    )
+    assert pad_len2 == 0 and pids2.shape == (1, 32)
+
+
+def test_update_tokenizer_model_max_length():
+    class Tok:
+        model_max_length = 512
+        init_kwargs = {}
+
+    tok = SparseAttentionUtils.update_tokenizer_model_max_length(Tok(), 2048)
+    assert tok.model_max_length == 2048
+    assert tok.init_kwargs["model_max_length"] == 2048
+
+
+def test_ds_elastic_cli(tmp_path, capsys):
+    from deeperspeed_tpu.elasticity.__main__ import main
+
+    cfg = {
+        "elasticity": {
+            "enabled": True,
+            "max_train_batch_size": 2000,
+            "micro_batch_sizes": [2, 4, 6],
+            "min_gpus": 1,
+            "max_gpus": 10000,
+            "min_time": 20,
+            "version": 0.1,
+        }
+    }
+    p = tmp_path / "elastic.json"
+    p.write_text(json.dumps(cfg))
+    main(["-c", str(p)])
+    out = capsys.readouterr().out
+    assert "final_batch_size" in out
+    main(["-c", str(p), "-w", "4"])
+    out = capsys.readouterr().out
+    assert "micro_batch_size" in out
