@@ -1,0 +1,2 @@
+from .gpt import GPTConfig, make_gpt, get_preset
+from .bert import BertConfig, make_bert, params_from_hf

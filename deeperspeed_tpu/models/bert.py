@@ -1,0 +1,260 @@
+"""BERT encoder family, TPU-native.
+
+The reference framework's headline results are BERT pretraining (SURVEY §6:
+64 TFLOPS/GPU seq128 — docs/_posts/2020-05-28-fastest-bert-training.md) and
+its kernel tests compare against HF BERT layers (tests/unit/modeling.py).
+This module is the rebuild's BERT: embeddings + a scan over fused
+transformer layers (ops/transformer) + pooler + tied MLM head.
+
+Design mirrors models/gpt.py: params are a pytree with per-layer tensors
+stacked on a leading axis so the encoder is one `lax.scan` (O(1) compile in
+depth, per-layer gather under ZeRO-3), remat per layer, TP/sequence sharding
+via PartitionSpecs over the same mesh axes.
+
+`params_from_hf(model)` imports a huggingface BertModel checkpoint wholesale
+(embeddings + every layer via module_inject), giving bit-compatible
+fine-tuning starts.
+"""
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.transformer import DeepSpeedTransformerConfig, init_transformer_params
+from ..ops.transformer.transformer import (
+    _layer_norm,
+    _transformer_forward,
+    to_numpy_f32,
+)
+from ..parallel.topology import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
+from .gpt import _shard_act
+from ..utils import hooks
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    n_layer: int = 12
+    n_head: int = 12
+    d_model: int = 768
+    d_ff: int = 0  # 0 => 4 * d_model
+    max_seq: int = 512
+    type_vocab_size: int = 2
+    layernorm_eps: float = 1e-12
+    initializer_range: float = 0.02
+    pre_layer_norm: bool = False  # classic BERT is post-LN
+    remat: bool = True
+    dtype: Any = jnp.bfloat16
+    attn_impl: str = "auto"
+    attn_dropout: float = 0.0
+    hidden_dropout: float = 0.0
+
+    @property
+    def ffn_dim(self):
+        return self.d_ff if self.d_ff else 4 * self.d_model
+
+    def layer_config(self) -> DeepSpeedTransformerConfig:
+        return DeepSpeedTransformerConfig(
+            batch_size=-1,
+            max_seq_length=self.max_seq,
+            hidden_size=self.d_model,
+            intermediate_size=self.ffn_dim,
+            heads=self.n_head,
+            attn_dropout_ratio=self.attn_dropout,
+            hidden_dropout_ratio=self.hidden_dropout,
+            num_hidden_layers=self.n_layer,
+            initializer_range=self.initializer_range,
+            fp16=self.dtype == jnp.bfloat16,
+            pre_layer_norm=self.pre_layer_norm,
+            layernorm_eps=self.layernorm_eps,
+            attn_impl=self.attn_impl,
+        )
+
+
+def init_params(rng, cfg: BertConfig):
+    ks = jax.random.split(rng, cfg.n_layer + 5)
+    std = cfg.initializer_range
+    f32 = jnp.float32
+    layer_cfg = cfg.layer_config()
+    per_layer = [init_transformer_params(ks[i], layer_cfg)
+                 for i in range(cfg.n_layer)]
+    layers = {k: jnp.stack([p[k] for p in per_layer]) for k in per_layer[0]}
+    D = cfg.d_model
+    return {
+        "embed": {
+            "word": jax.random.normal(ks[-4], (cfg.vocab_size, D), f32) * std,
+            "pos": jax.random.normal(ks[-3], (cfg.max_seq, D), f32) * std,
+            "type": jax.random.normal(ks[-2], (cfg.type_vocab_size, D), f32) * std,
+            "ln_w": jnp.ones((D,), f32),
+            "ln_b": jnp.zeros((D,), f32),
+        },
+        "layers": layers,
+        "pooler": {
+            "w": jax.random.normal(ks[-1], (D, D), f32) * std,
+            "b": jnp.zeros((D,), f32),
+        },
+        "mlm": {  # transform dense + LN; decoder tied to word embeddings
+            "w": jax.random.normal(ks[-5], (D, D), f32) * std,
+            "b": jnp.zeros((D,), f32),
+            "ln_w": jnp.ones((D,), f32),
+            "ln_b": jnp.zeros((D,), f32),
+            "bias": jnp.zeros((cfg.vocab_size,), f32),
+        },
+    }
+
+
+def param_specs(cfg: BertConfig):
+    """TP sharding over the 'model' axis, matching gpt.param_specs: QKV/FFN
+    columns sharded, output rows sharded, embeddings vocab-sharded."""
+    from jax.sharding import PartitionSpec as P
+
+    L = P  # brevity
+    return {
+        "embed": {"word": L(MODEL_AXIS, None), "pos": L(), "type": L(),
+                  "ln_w": L(), "ln_b": L()},
+        "layers": {
+            "attn_qkvw": L(None, None, MODEL_AXIS),
+            "attn_qkvb": L(None, MODEL_AXIS),
+            "attn_ow": L(None, MODEL_AXIS, None),
+            "attn_ob": L(None, None),
+            "attn_nw": L(None, None), "attn_nb": L(None, None),
+            "inter_w": L(None, None, MODEL_AXIS),
+            "inter_b": L(None, MODEL_AXIS),
+            "output_w": L(None, MODEL_AXIS, None),
+            "output_b": L(None, None),
+            "norm_w": L(None, None), "norm_b": L(None, None),
+        },
+        "pooler": {"w": L(), "b": L()},
+        "mlm": {"w": L(), "b": L(), "ln_w": L(), "ln_b": L(),
+                "bias": L(MODEL_AXIS)},
+    }
+
+
+def make_bert(cfg: BertConfig, mesh=None):
+    """Returns (init_fn, apply_fn, mlm_loss_fn, specs).
+
+    apply_fn(params, input_ids, token_type_ids=None, attention_mask=None)
+        -> (sequence_output, pooled_output)
+    mlm_loss_fn(params, batch) with batch = (input_ids, labels) where
+        labels == -100 marks unscored positions (HF convention).
+    """
+    layer_cfg = cfg.layer_config()
+
+    def apply_fn(params, input_ids, token_type_ids=None, attention_mask=None):
+        cdt = cfg.dtype
+        B, S = input_ids.shape
+        e = params["embed"]
+        x = jnp.take(e["word"].astype(cdt), input_ids, axis=0)
+        x = x + e["pos"][:S].astype(cdt)
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        x = x + jnp.take(e["type"].astype(cdt), token_type_ids, axis=0)
+        x = _layer_norm(x, e["ln_w"].astype(cdt), e["ln_b"].astype(cdt),
+                        cfg.layernorm_eps)
+        # context-parallel long sequences: activations sharded over the
+        # 'seq' axis (as in make_gpt)
+        from jax.sharding import PartitionSpec as P
+
+        x = _shard_act(x, mesh, P(DATA_AXIS, SEQ_AXIS, None))
+
+        additive = None
+        if attention_mask is not None:
+            additive = (1.0 - attention_mask[:, None, None, :].astype(jnp.float32)) * -1e4
+
+        def block(h, layer_params):
+            return _transformer_forward(layer_params, h, layer_cfg,
+                                        attention_mask=additive)
+
+        step = jax.checkpoint(block, prevent_cse=False) if cfg.remat else block
+
+        def scan_body(carry, xs):
+            layer_params, idx = xs
+            out = step(carry, layer_params)
+            out = hooks.record_layer_output("bertlayer", out, idx)
+            return out, None
+
+        layer_ids = jnp.arange(cfg.n_layer, dtype=jnp.int32)
+        x, _ = jax.lax.scan(scan_body, x, (params["layers"], layer_ids))
+
+        pooled = jnp.tanh(x[:, 0] @ params["pooler"]["w"].astype(cdt)
+                          + params["pooler"]["b"].astype(cdt))
+        return x, pooled
+
+    def mlm_logits(params, sequence_output):
+        cdt = cfg.dtype
+        m = params["mlm"]
+        h = jax.nn.gelu(sequence_output @ m["w"].astype(cdt) + m["b"].astype(cdt),
+                        approximate=False)
+        h = _layer_norm(h, m["ln_w"], m["ln_b"], cfg.layernorm_eps)
+        return h @ params["embed"]["word"].astype(cdt).T + m["bias"].astype(cdt)
+
+    def mlm_loss_fn(params, batch):
+        input_ids, labels = batch[0], batch[1]
+        attention_mask = batch[2] if len(batch) > 2 else None
+        seq_out, _ = apply_fn(params, input_ids, attention_mask=attention_mask)
+        logits = mlm_logits(params, seq_out).astype(jnp.float32)
+        valid = labels != -100
+        safe_labels = jnp.where(valid, labels, 0)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
+        denom = jnp.maximum(jnp.sum(valid), 1)
+        return jnp.sum(jnp.where(valid, nll, 0.0)) / denom
+
+    def init_fn(rng):
+        return init_params(rng, cfg)
+
+    apply_fn.mlm_logits = mlm_logits
+    return init_fn, apply_fn, mlm_loss_fn, param_specs(cfg)
+
+
+def params_from_hf(model, cfg: Optional[BertConfig] = None):
+    """Import a huggingface BertModel/BertForMaskedLM checkpoint into the
+    stacked param pytree (embeddings + all layers via module_inject)."""
+    from ..module_inject import replace_transformer_layer
+
+    bert = getattr(model, "bert", model)
+    hf_cfg = model.config
+    if cfg is None:
+        cfg = BertConfig(
+            vocab_size=hf_cfg.vocab_size,
+            n_layer=hf_cfg.num_hidden_layers,
+            n_head=hf_cfg.num_attention_heads,
+            d_model=hf_cfg.hidden_size,
+            d_ff=hf_cfg.intermediate_size,
+            max_seq=hf_cfg.max_position_embeddings,
+            type_vocab_size=hf_cfg.type_vocab_size,
+            layernorm_eps=hf_cfg.layer_norm_eps,
+            dtype=jnp.float32,
+        )
+    _, _, stacked = replace_transformer_layer(model=bert, fp16=False,
+                                              attn_impl=cfg.attn_impl)
+    emb = bert.embeddings
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    params["layers"] = stacked
+    params["embed"] = {
+        "word": jnp.asarray(to_numpy_f32(emb.word_embeddings.weight)),
+        "pos": jnp.asarray(to_numpy_f32(emb.position_embeddings.weight)),
+        "type": jnp.asarray(to_numpy_f32(emb.token_type_embeddings.weight)),
+        "ln_w": jnp.asarray(to_numpy_f32(emb.LayerNorm.weight)),
+        "ln_b": jnp.asarray(to_numpy_f32(emb.LayerNorm.bias)),
+    }
+    if getattr(bert, "pooler", None) is not None:
+        params["pooler"] = {
+            "w": jnp.asarray(to_numpy_f32(bert.pooler.dense.weight).T),
+            "b": jnp.asarray(to_numpy_f32(bert.pooler.dense.bias)),
+        }
+    # MLM head (BertForMaskedLM / BertForPreTraining: cls.predictions)
+    cls = getattr(model, "cls", None)
+    predictions = getattr(cls, "predictions", None) if cls is not None else None
+    if predictions is not None:
+        tr = predictions.transform
+        params["mlm"] = {
+            "w": jnp.asarray(to_numpy_f32(tr.dense.weight).T),
+            "b": jnp.asarray(to_numpy_f32(tr.dense.bias)),
+            "ln_w": jnp.asarray(to_numpy_f32(tr.LayerNorm.weight)),
+            "ln_b": jnp.asarray(to_numpy_f32(tr.LayerNorm.bias)),
+            "bias": jnp.asarray(to_numpy_f32(predictions.decoder.bias)),
+        }
+    return cfg, params
