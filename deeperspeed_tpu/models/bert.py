@@ -112,7 +112,10 @@ def param_specs(cfg: BertConfig):
 
     L = P  # brevity
     return {
-        "embed": {"word": L(MODEL_AXIS, None), "pos": L(), "type": L(),
+        # word embedding sharded over d_model, not vocab — XLA's gather from
+        # a vocab-sharded table falls back to full replication (see the same
+        # note in gpt.param_specs)
+        "embed": {"word": L(None, MODEL_AXIS), "pos": L(), "type": L(),
                   "ln_w": L(), "ln_b": L()},
         "layers": {
             "attn_qkvw": L(None, None, MODEL_AXIS),
@@ -128,7 +131,7 @@ def param_specs(cfg: BertConfig):
         },
         "pooler": {"w": L(), "b": L()},
         "mlm": {"w": L(), "b": L(), "ln_w": L(), "ln_b": L(),
-                "bias": L(MODEL_AXIS)},
+                "bias": L()},
     }
 
 
@@ -142,7 +145,8 @@ def make_bert(cfg: BertConfig, mesh=None):
     """
     layer_cfg = cfg.layer_config()
 
-    def apply_fn(params, input_ids, token_type_ids=None, attention_mask=None):
+    def apply_fn(params, input_ids, token_type_ids=None, attention_mask=None,
+                 rng=None):
         cdt = cfg.dtype
         B, S = input_ids.shape
         e = params["embed"]
@@ -163,15 +167,17 @@ def make_bert(cfg: BertConfig, mesh=None):
         if attention_mask is not None:
             additive = (1.0 - attention_mask[:, None, None, :].astype(jnp.float32)) * -1e4
 
-        def block(h, layer_params):
+        def block(h, layer_params, layer_rng):
             return _transformer_forward(layer_params, h, layer_cfg,
-                                        attention_mask=additive)
+                                        attention_mask=additive,
+                                        rng=layer_rng)
 
         step = jax.checkpoint(block, prevent_cse=False) if cfg.remat else block
 
         def scan_body(carry, xs):
             layer_params, idx = xs
-            out = step(carry, layer_params)
+            layer_rng = None if rng is None else jax.random.fold_in(rng, idx)
+            out = step(carry, layer_params, layer_rng)
             out = hooks.record_layer_output("bertlayer", out, idx)
             return out, None
 
@@ -190,10 +196,11 @@ def make_bert(cfg: BertConfig, mesh=None):
         h = _layer_norm(h, m["ln_w"], m["ln_b"], cfg.layernorm_eps)
         return h @ params["embed"]["word"].astype(cdt).T + m["bias"].astype(cdt)
 
-    def mlm_loss_fn(params, batch):
+    def mlm_loss_fn(params, batch, rng=None):
         input_ids, labels = batch[0], batch[1]
         attention_mask = batch[2] if len(batch) > 2 else None
-        seq_out, _ = apply_fn(params, input_ids, attention_mask=attention_mask)
+        seq_out, _ = apply_fn(params, input_ids, attention_mask=attention_mask,
+                              rng=rng)
         logits = mlm_logits(params, seq_out).astype(jnp.float32)
         valid = labels != -100
         safe_labels = jnp.where(valid, labels, 0)

@@ -85,6 +85,23 @@ def test_mlm_head_parity_vs_hf():
     np.testing.assert_allclose(logits, ref_logits, atol=2e-3, rtol=2e-3)
 
 
+def test_dropout_active_with_rng():
+    cfg = _small_cfg(attn_dropout=0.3, hidden_dropout=0.3)
+    init_fn, apply_fn, loss_fn, _ = make_bert(cfg)
+    params = init_fn(jax.random.PRNGKey(0))
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 128, (2, 16)))
+    a = apply_fn(params, ids, rng=jax.random.PRNGKey(1))[0]
+    b = apply_fn(params, ids, rng=jax.random.PRNGKey(2))[0]
+    c = apply_fn(params, ids)[0]
+    d = apply_fn(params, ids)[0]
+    assert not np.allclose(np.asarray(a), np.asarray(b))  # dropout applied
+    np.testing.assert_allclose(np.asarray(c), np.asarray(d))  # eval: none
+    # engine path threads rng into the 3-arg loss fn
+    l = loss_fn(params, (ids, jnp.full((2, 16), -100).at[:, 1].set(ids[:, 1])),
+                jax.random.PRNGKey(3))
+    assert np.isfinite(float(l))
+
+
 def test_mlm_loss_ignores_unlabeled_positions():
     cfg = _small_cfg()
     init_fn, _, loss_fn, _ = make_bert(cfg)
@@ -120,11 +137,11 @@ def test_bert_trains_through_engine():
 
 
 def test_tp_sharded_bert_runs():
-    devs = jax.devices()[:8]
-    mesh = Mesh(np.array(devs).reshape(4, 2), (  # dp x tp
-        "data", "model"))
+    from deeperspeed_tpu import build_mesh
+
+    mesh = build_mesh({"data": 4, "model": 2})
     cfg = _small_cfg(n_layer=2, d_model=32, n_head=2)
-    init_fn, apply_fn, loss_fn, specs = make_bert(cfg)
+    init_fn, apply_fn, loss_fn, specs = make_bert(cfg, mesh=mesh)
     params = init_fn(jax.random.PRNGKey(0))
     from deeperspeed_tpu.runtime.zero import partition
 

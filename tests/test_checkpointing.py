@@ -1,0 +1,145 @@
+"""Checkpoint edge cases (reference tests/unit/test_checkpointing.py
+analog, beyond the round-trips in test_engine.py): client state, lr
+scheduler restore, load_module_only, missing/mismatched tags, ZeRO-stage
+cross-load, and fresh-engine resume equivalence."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deeperspeed_tpu as deepspeed
+
+
+def _loss_fn(p, b):
+    x, y = b
+    return jnp.mean((x @ p["w"] - y) ** 2)
+
+
+def _engine(stage=0, lr=1e-2, scheduler=True, seed=0):
+    cfg = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "Adam", "params": {"lr": lr}},
+        "zero_optimization": {"stage": stage},
+    }
+    if scheduler:
+        cfg["scheduler"] = {"type": "WarmupLR",
+                            "params": {"warmup_max_lr": lr,
+                                       "warmup_num_steps": 10}}
+    params = {"w": jax.random.normal(jax.random.PRNGKey(seed), (4, 2)) * 0.1}
+    engine, _, _, sched = deepspeed.initialize(
+        model=_loss_fn, model_parameters=params, config_params=cfg
+    )
+    return engine, sched
+
+
+def _batch(seed=0):
+    rs = np.random.RandomState(seed)
+    return (jnp.asarray(rs.randn(8, 4).astype(np.float32)),
+            jnp.asarray(rs.randn(8, 2).astype(np.float32)))
+
+
+def test_client_state_round_trip(tmp_path):
+    engine, _ = _engine()
+    engine.train_batch(batch=_batch())
+    engine.save_checkpoint(str(tmp_path), client_state={"epoch": 7, "note": "x"})
+    _, client = engine.load_checkpoint(str(tmp_path))
+    assert client["epoch"] == 7 and client["note"] == "x"
+
+
+def test_lr_scheduler_state_restored(tmp_path):
+    engine, sched = _engine()
+    for _ in range(5):
+        engine.train_batch(batch=_batch())
+    lr_at_save = sched.get_lr()
+    engine.save_checkpoint(str(tmp_path))
+
+    engine2, sched2 = _engine(seed=1)  # fresh engine, different init
+    engine2.load_checkpoint(str(tmp_path))
+    assert sched2.get_lr() == pytest.approx(lr_at_save)
+    assert engine2.global_steps == 5
+
+
+def test_load_module_only_skips_optimizer(tmp_path):
+    engine, _ = _engine(stage=1)
+    for _ in range(3):
+        engine.train_batch(batch=_batch())
+    engine.save_checkpoint(str(tmp_path))
+
+    engine2, _ = _engine(stage=1, seed=1)
+    engine2.load_checkpoint(str(tmp_path), load_module_only=True)
+    # params restored...
+    np.testing.assert_allclose(
+        np.asarray(engine2.state.params["w"], np.float32),
+        np.asarray(engine.state.params["w"], np.float32), rtol=1e-3, atol=1e-5)
+    # ...but optimizer moments untouched (still zeros from fresh init)
+    m = engine2.state.opt_state.exp_avg["w"]
+    np.testing.assert_allclose(np.asarray(m), 0.0)
+
+
+def test_missing_tag_returns_none(tmp_path):
+    engine, _ = _engine()
+    out, client = engine.load_checkpoint(str(tmp_path))  # empty dir
+    assert out is None and client == {}
+    # explicit bogus tag
+    out, client = engine.load_checkpoint(str(tmp_path), tag="global_step999")
+    assert out is None
+
+
+def test_resume_matches_uninterrupted_training(tmp_path):
+    """Train 10 steps straight vs train 5 + checkpoint + resume in a fresh
+    engine + 5 more: identical weights (reference run_checkpoint_test)."""
+    straight, _ = _engine()
+    for i in range(10):
+        straight.train_batch(batch=_batch(i))
+
+    first, _ = _engine()
+    for i in range(5):
+        first.train_batch(batch=_batch(i))
+    first.save_checkpoint(str(tmp_path))
+
+    resumed, _ = _engine(seed=1)
+    resumed.load_checkpoint(str(tmp_path))
+    for i in range(5, 10):
+        resumed.train_batch(batch=_batch(i))
+
+    np.testing.assert_allclose(
+        np.asarray(resumed.state.params["w"], np.float32),
+        np.asarray(straight.state.params["w"], np.float32),
+        rtol=1e-4, atol=1e-5,
+    )
+    assert resumed.global_steps == straight.global_steps == 10
+
+
+@pytest.mark.parametrize("save_stage,load_stage", [(1, 2), (2, 1), (0, 2)])
+def test_cross_stage_load(tmp_path, save_stage, load_stage):
+    """ZeRO re-sharding across stages: a checkpoint written under one stage
+    restores under another (the sharding is a device-placement concern, not
+    a file-format one — the elastic property reference stage1
+    _elastic_load_state_dict provides)."""
+    engine, _ = _engine(stage=save_stage)
+    for _ in range(3):
+        engine.train_batch(batch=_batch())
+    engine.save_checkpoint(str(tmp_path))
+
+    engine2, _ = _engine(stage=load_stage, seed=1)
+    engine2.load_checkpoint(str(tmp_path))
+    np.testing.assert_allclose(
+        np.asarray(engine2.state.params["w"], np.float32),
+        np.asarray(engine.state.params["w"], np.float32), rtol=1e-3, atol=1e-5)
+    # training continues healthily under the new stage
+    l = float(engine2.train_batch(batch=_batch()))
+    assert np.isfinite(l)
+
+
+def test_save_latest_false_leaves_no_pointer(tmp_path):
+    engine, _ = _engine()
+    engine.train_batch(batch=_batch())
+    engine.save_checkpoint(str(tmp_path), tag="manual", save_latest=False)
+    assert not os.path.exists(tmp_path / "latest")
+    out, _ = engine.load_checkpoint(str(tmp_path))  # no latest -> nothing
+    assert out is None
+    out, _ = engine.load_checkpoint(str(tmp_path), tag="manual")
+    assert out is not None

@@ -1,0 +1,111 @@
+"""Dynamic loss scaler semantics (reference tests/unit/
+test_dynamic_loss_scale.py analog): 2x growth per window, halve on overflow,
+hysteresis, min scale floor, and engine skip-step behavior."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deeperspeed_tpu as deepspeed
+from deeperspeed_tpu.runtime.fp16.loss_scaler import (
+    DynamicLossScaler,
+    StaticLossScaler,
+    create_loss_scaler,
+)
+
+
+def _roll(scaler, state, overflows):
+    for ov in overflows:
+        state = scaler.update(state, jnp.asarray(ov))
+    return state
+
+
+def test_grows_every_window():
+    s = DynamicLossScaler(init_scale=2.0, scale_window=5)
+    st = s.init()
+    st = _roll(s, st, [False] * 4)
+    assert float(st.loss_scale) == 2.0  # not yet at window
+    st = _roll(s, st, [False])
+    assert float(st.loss_scale) == 4.0  # window boundary doubles
+    st = _roll(s, st, [False] * 5)
+    assert float(st.loss_scale) == 8.0
+
+
+def test_overflow_halves_and_resets_window():
+    s = DynamicLossScaler(init_scale=16.0, scale_window=3)
+    st = s.init()
+    st = _roll(s, st, [False, False, True])
+    assert float(st.loss_scale) == 8.0
+    # good-step counter restarted: needs a full window again
+    st = _roll(s, st, [False, False])
+    assert float(st.loss_scale) == 8.0
+    st = _roll(s, st, [False])
+    assert float(st.loss_scale) == 16.0
+
+
+def test_min_scale_floor():
+    s = DynamicLossScaler(init_scale=4.0, min_scale=1.0)
+    st = s.init()
+    st = _roll(s, st, [True] * 10)
+    assert float(st.loss_scale) == 1.0
+
+
+def test_hysteresis_delays_shrink():
+    s = DynamicLossScaler(init_scale=8.0, delayed_shift=3)
+    st = s.init()
+    st = _roll(s, st, [True])  # consumes hysteresis 3 -> 2
+    assert float(st.loss_scale) == 8.0
+    st = _roll(s, st, [True])  # 2 -> 1
+    assert float(st.loss_scale) == 8.0
+    st = _roll(s, st, [True])  # exhausted: halve
+    assert float(st.loss_scale) == 4.0
+
+
+def test_static_scaler_never_moves():
+    s = StaticLossScaler(scale=128.0)
+    st = s.init()
+    st = _roll(s, st, [True, False, True])
+    assert float(st.loss_scale) == 128.0
+    assert not s.dynamic
+
+
+def test_create_loss_scaler_dispatch():
+    dyn = create_loss_scaler("fp16", static_loss_scale=0)
+    assert dyn.dynamic
+    stat = create_loss_scaler("fp16", static_loss_scale=64)
+    assert not stat.dynamic and float(stat.init().loss_scale) == 64.0
+    bf16 = create_loss_scaler("bfloat16")
+    assert float(bf16.init().loss_scale) == 1.0
+
+
+def test_engine_skips_step_on_overflow():
+    """An exploding loss under fp16 must shrink the scale and skip the
+    update rather than poisoning the weights (reference engine.py:1184)."""
+
+    def loss_fn(p, b):
+        x, y = b
+        # gigantic loss -> scaled grads overflow fp16 range at high scale
+        return jnp.mean((x @ p["w"] - y) ** 2) * 1e30
+
+    engine, _, _, _ = deepspeed.initialize(
+        model=loss_fn, model_parameters={"w": jnp.ones((4, 2))},
+        config_params={
+            "train_batch_size": 8,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+            "fp16": {"enabled": True, "loss_scale": 0,
+                     "initial_scale_power": 32},
+        },
+    )
+    before = np.asarray(engine.state.params["w"], np.float32)
+    scale0 = float(engine.loss_scale())
+    x = np.random.RandomState(0).randn(8, 4).astype(np.float32)
+    y = np.random.RandomState(1).randn(8, 2).astype(np.float32)
+    # default hysteresis=2: the first overflow is absorbed, the second
+    # shrinks the scale; neither applies the update
+    for _ in range(2):
+        engine.train_batch(batch=(jnp.asarray(x), jnp.asarray(y)))
+    after = np.asarray(engine.state.params["w"], np.float32)
+    assert float(engine.loss_scale()) < scale0  # shrunk after hysteresis
+    np.testing.assert_array_equal(before, after)  # steps skipped
+    assert int(jax.device_get(engine.state.skipped)) >= 2

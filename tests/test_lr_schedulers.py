@@ -1,0 +1,122 @@
+"""LR schedule tests (reference tests/unit/test_lr_schedulers.py analog):
+schedule math, state round-trips, and engine scheduler config dispatch."""
+
+import math
+
+import numpy as np
+import pytest
+
+from deeperspeed_tpu.runtime.lr_schedules import (
+    LRRangeTest,
+    OneCycle,
+    WarmupLR,
+    WarmupDecayLR,
+)
+
+
+def _run(sched, n):
+    lrs = []
+    for _ in range(n):
+        sched.step()
+        lrs.append(sched.get_lr())
+    return lrs
+
+
+def test_lr_range_test_continuous():
+    s = LRRangeTest(lr_range_test_min_lr=1e-4, lr_range_test_step_size=10,
+                    lr_range_test_step_rate=1.0)
+    lrs = _run(s, 30)
+    assert lrs[0] == pytest.approx(1e-4)
+    # linearly increasing
+    assert all(b > a for a, b in zip(lrs, lrs[1:]))
+    assert lrs[19] == pytest.approx(1e-4 * (1 + 19 / 10))
+
+
+def test_lr_range_test_staircase():
+    s = LRRangeTest(lr_range_test_min_lr=1e-4, lr_range_test_step_size=10,
+                    lr_range_test_staircase=True)
+    lrs = _run(s, 25)
+    assert lrs[0] == lrs[9] == pytest.approx(1e-4)
+    assert lrs[10] == lrs[19] == pytest.approx(2e-4)
+    assert lrs[20] == pytest.approx(3e-4)
+
+
+def test_one_cycle_up_down():
+    s = OneCycle(cycle_min_lr=0.01, cycle_max_lr=0.1,
+                 cycle_first_step_size=10, cycle_second_step_size=10)
+    lrs = _run(s, 20)
+    peak = max(lrs)
+    assert peak == pytest.approx(0.1, rel=1e-6)
+    # first step() lands on iteration 0, so the peak is at index 10
+    assert np.argmax(lrs) == 10
+    assert lrs[0] < lrs[5] < lrs[10]
+    assert lrs[10] > lrs[15] > lrs[19]
+    assert lrs[19] == pytest.approx(0.01 + 0.009, rel=1e-2)  # one step above min
+
+
+def test_one_cycle_decay_phase():
+    s = OneCycle(cycle_min_lr=0.01, cycle_max_lr=0.1,
+                 cycle_first_step_size=5, cycle_second_step_size=5,
+                 decay_lr_rate=0.5, decay_step_size=2)
+    lrs = _run(s, 20)
+    # after the cycle (10 steps), lr decays below cycle_min_lr
+    assert lrs[-1] < 0.01
+
+
+def test_warmup_lr_log_curve_and_hold():
+    s = WarmupLR(warmup_min_lr=0.0, warmup_max_lr=0.1, warmup_num_steps=10)
+    lrs = _run(s, 20)
+    assert all(b >= a for a, b in zip(lrs[:10], lrs[1:10]))
+    # log-shaped warmup: value at step t is log(t+1)/log(n+1) * max
+    assert lrs[4] == pytest.approx(0.1 * math.log(5) / math.log(11), rel=1e-6)
+    for lr in lrs[10:]:
+        assert lr == pytest.approx(0.1)
+
+
+def test_warmup_decay_lr_reaches_zero():
+    s = WarmupDecayLR(total_num_steps=20, warmup_min_lr=0.0,
+                      warmup_max_lr=0.1, warmup_num_steps=5)
+    lrs = _run(s, 21)  # step() starts at iteration 0: 21 steps reach it=20
+    assert max(lrs) == pytest.approx(0.1)
+    assert lrs[-1] == pytest.approx(0.0, abs=1e-6)
+    assert lrs[6] > lrs[10] > lrs[15]
+
+
+def test_schedule_state_round_trip():
+    s = WarmupLR(warmup_max_lr=0.1, warmup_num_steps=10)
+    _run(s, 7)
+    sd = s.state_dict()
+    s2 = WarmupLR(warmup_max_lr=0.1, warmup_num_steps=10)
+    s2.load_state_dict(sd)
+    assert s2.get_lr() == s.get_lr()
+    assert s2.get_last_lr() == [s.get_lr()]
+
+
+def test_engine_scheduler_dispatch():
+    import jax.numpy as jnp
+    import deeperspeed_tpu as deepspeed
+
+    def loss_fn(p, b):
+        x, y = b
+        return jnp.mean((x @ p["w"] - y) ** 2)
+
+    engine, _, _, sched = deepspeed.initialize(
+        model=loss_fn, model_parameters={"w": jnp.zeros((4, 1))},
+        config_params={
+            "train_batch_size": 8,
+            "optimizer": {"type": "Adam", "params": {"lr": 0.1}},
+            "scheduler": {"type": "WarmupDecayLR",
+                          "params": {"warmup_max_lr": 0.1,
+                                     "warmup_num_steps": 3,
+                                     "total_num_steps": 10}},
+        },
+    )
+    assert isinstance(sched, WarmupDecayLR)
+    x = np.random.RandomState(0).randn(8, 4).astype(np.float32)
+    y = np.random.RandomState(1).randn(8, 1).astype(np.float32)
+    lrs = []
+    for _ in range(10):
+        engine.train_batch(batch=(jnp.asarray(x), jnp.asarray(y)))
+        lrs.append(sched.get_lr())
+    assert max(lrs) == pytest.approx(0.1, rel=1e-6)
+    assert lrs[-1] < lrs[3]

@@ -1,0 +1,85 @@
+"""Runtime utils tests (reference tests/unit/test_runtime_utils.py +
+test_partition.py analogs): balanced partitioning, PartitionedTensor,
+norms/clipping, GradientNoiseScale, memory helpers."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeperspeed_tpu.runtime.utils import (
+    GradientNoiseScale,
+    PartitionedTensor,
+    clip_by_global_norm,
+    global_norm,
+    mem_status,
+    memory_status,
+    partition_balanced,
+    partition_uniform,
+    see_memory_usage,
+)
+
+
+def test_partition_uniform_boundaries():
+    parts = partition_uniform(10, 3)
+    assert parts == [0, 4, 7, 10]  # remainder to leading parts
+    assert partition_uniform(8, 4) == [0, 2, 4, 6, 8]
+    assert partition_uniform(2, 2) == [0, 1, 2]
+
+
+def test_partition_balanced_minimizes_max_load():
+    # weights chosen so uniform splitting is suboptimal
+    weights = [1, 1, 1, 100, 1, 1, 1]
+    parts = partition_balanced(weights, 2)
+    loads = [sum(weights[parts[i]:parts[i + 1]]) for i in range(2)]
+    assert max(loads) == 103  # optimum: [1,1,1,100] | [1,1,1] -> 103/3
+    # every boundary list is monotone covering all items
+    assert parts[0] == 0 and parts[-1] == len(weights)
+    assert all(b >= a for a, b in zip(parts, parts[1:]))
+
+
+def test_partition_balanced_equal_weights_matches_uniform():
+    assert partition_balanced([5] * 8, 4) == partition_uniform(8, 4)
+
+
+def test_partitioned_tensor_round_trip():
+    t = np.arange(10, dtype=np.float32).reshape(2, 5)
+    pt = PartitionedTensor(t, num_parts=4)
+    meta = pt.to_meta()
+    parts = [pt.data(i) for i in range(4)]
+    # padded to equal chunk sizes
+    assert all(p.size == parts[0].size for p in parts)
+    out = PartitionedTensor.from_parts(meta, parts)
+    np.testing.assert_array_equal(out, t)
+
+
+def test_global_norm_and_clip():
+    tree = {"a": jnp.full((4,), 3.0), "b": jnp.full((2, 2), 4.0)}
+    n = float(global_norm(tree))
+    assert n == pytest.approx(np.sqrt(4 * 9 + 4 * 16))
+    clipped, norm = clip_by_global_norm(tree, 1.0)
+    assert float(norm) == pytest.approx(n)
+    assert float(global_norm(clipped)) == pytest.approx(1.0, rel=1e-4)
+    # under the cap: unchanged
+    same, _ = clip_by_global_norm(tree, 100.0)
+    np.testing.assert_allclose(np.asarray(same["a"]), 3.0)
+
+
+def test_gradient_noise_scale():
+    gns = GradientNoiseScale(batch_size_small=8, batch_size_big=64, beta=0.5)
+    for _ in range(50):
+        gns.update(norm_small_sq=10.0, norm_big_sq=2.0)
+    # B_noise = trace / signal with the standard unbiased estimators
+    assert np.isfinite(gns.noise_scale)
+    assert gns.noise_scale > 0
+
+
+def test_memory_helpers_run():
+    s = memory_status()
+    assert "bytes_in_use" in s
+    see_memory_usage("unit-test", force=True)
+    out = mem_status("unit-test")
+    assert "bytes_in_use" in out
+    # rank-gated variant returns stats without logging
+    out2 = mem_status("other", print_rank=5)
+    assert "bytes_in_use" in out2
