@@ -123,7 +123,19 @@ class CheckpointEngine:
 
 def consolidate_fp32_state(checkpoint_dir: str) -> Dict:
     """zero_to_fp32 equivalent (reference utils/zero_to_fp32.py:70): returns
-    the consolidated fp32 master weights from a checkpoint dir."""
+    the consolidated fp32 master weights from a checkpoint dir (either file
+    layout: msgpack shards or orbax sharded_io)."""
+    sharded = os.path.join(checkpoint_dir, SHARDED_STATE_DIR)
+    if os.path.isdir(sharded):
+        import orbax.checkpoint as ocp
+
+        with ocp.StandardCheckpointer() as ckptr:
+            optim_dir = os.path.join(sharded, "optim")
+            if os.path.isdir(optim_dir):
+                optim = ckptr.restore(os.path.abspath(optim_dir))
+                if isinstance(optim, dict) and optim.get("master"):
+                    return optim["master"]
+            return ckptr.restore(os.path.abspath(os.path.join(sharded, "params")))
     for fname in sorted(os.listdir(checkpoint_dir)):
         if fname.startswith("zero_pp_rank_") and fname.endswith(".msgpack"):
             optim = load_tree(os.path.join(checkpoint_dir, fname))
@@ -133,5 +145,51 @@ def consolidate_fp32_state(checkpoint_dir: str) -> Dict:
     for fname in sorted(os.listdir(checkpoint_dir)):
         if fname.endswith("model_states.msgpack"):
             state = load_tree(os.path.join(checkpoint_dir, fname))
-            return state.get("module", state)
+            if "module" not in state:
+                raise FileNotFoundError(
+                    f"{fname} carries no module weights (metadata only?) in "
+                    f"{checkpoint_dir}"
+                )
+            return state["module"]
     raise FileNotFoundError(f"no checkpoint states found in {checkpoint_dir}")
+
+
+# ---------------------------------------------------------------------------
+# orbax-backed sharded IO (per-process parallel shard files; the scalable
+# analog of the reference's zero_pp_rank_* per-rank files)
+# ---------------------------------------------------------------------------
+
+SHARDED_STATE_DIR = "sharded_state"
+
+
+def save_sharded_tree(path: str, tree: Any):
+    """Write a device pytree with orbax: each process persists only its own
+    addressable shards, in parallel — no gather, no replication."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path, tree, force=True)
+
+
+def load_sharded_tree(path: str, target: Any):
+    """Restore a tree saved by save_sharded_tree onto ``target``'s current
+    shapes/dtypes/shardings (orbax re-shards, so the mesh/world size may
+    differ from save time — elastic resume)."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                       sharding=getattr(x, "sharding", None)),
+        target,
+    )
+    with ocp.StandardCheckpointer() as ckptr:
+        restored = ckptr.restore(path, abstract)
+    # guarantee the target placement (orbax may land leaves whose abstract
+    # sharding was unavailable on a single device)
+    return jax.tree.map(
+        lambda r, t: jax.device_put(r, t.sharding)
+        if getattr(t, "sharding", None) is not None else r,
+        restored, target,
+    )

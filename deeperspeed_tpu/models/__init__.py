@@ -1,2 +1,3 @@
 from .gpt import GPTConfig, make_gpt, get_preset
 from .bert import BertConfig, make_bert, params_from_hf
+from .generation import make_generator, init_cache, apply_with_cache

@@ -1,0 +1,176 @@
+"""KV-cache autoregressive generation for the GPT family.
+
+The reference serves generation through the fork's
+``PipelineEngine.inference_batch`` (reference runtime/pipe/engine.py:422 —
+GPT-NeoX calls it per decoding step, recomputing the whole prefix each
+time). The TPU rebuild keeps that API on the pipeline engine and adds the
+design the hardware actually wants: a static-shape KV cache updated with
+``dynamic_update_slice`` and a ``lax.scan`` over decode steps, so the whole
+generate loop is ONE compiled program (no per-token dispatch, no prefix
+recompute).
+
+Usage::
+
+    gen = make_generator(cfg)          # cfg: models.gpt.GPTConfig
+    out = gen(params, prompt_ids, max_new_tokens=64,
+              temperature=1.0, top_k=40, rng=key)   # (B, S+64) tokens
+
+temperature=0 (default) is greedy argmax. The prompt is prefilled in one
+pass; decode steps attend to the cache only.
+"""
+
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .gpt import GPTConfig, layer_norm, rotary_embedding
+
+
+def init_cache(cfg: GPTConfig, batch: int, max_len: int):
+    """Stacked per-layer KV cache: (L, B, max_len, H, Dh)."""
+    shape = (cfg.n_layer, batch, max_len, cfg.n_head, cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, cfg.dtype),
+        "v": jnp.zeros(shape, cfg.dtype),
+    }
+
+
+def _cached_block(cfg: GPTConfig, x, layer_params, k_cache, v_cache,
+                  offset, positions):
+    """One decoder layer over S new tokens with a KV cache.
+
+    x: (B, S, D); k/v_cache: (B, max_len, H, Dh); offset: scalar — number of
+    tokens already cached. Returns (x_out, k_cache, v_cache).
+
+    This mirrors gpt.make_gpt's block with only the attention KV source
+    changed — keep the two in sync (the prefill/incremental parity tests in
+    tests/test_generation.py fail on any divergence)."""
+    cdt = cfg.dtype
+    B, S, D = x.shape
+    H, Dh = cfg.n_head, cfg.head_dim
+    attn_in = layer_norm(x, layer_params["ln1_scale"], layer_params["ln1_bias"],
+                         cfg.layernorm_eps)
+    qkv = attn_in @ layer_params["attn"]["wqkv"].astype(cdt) \
+        + layer_params["attn"]["bqkv"].astype(cdt)
+    qkv = qkv.reshape(B, S, 3, H, Dh)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    if cfg.rotary:
+        rd = int(cfg.rotary_pct * Dh) // 2 * 2
+        q = rotary_embedding(q, positions, rd)
+        k = rotary_embedding(k, positions, rd)
+    k_cache = jax.lax.dynamic_update_slice(k_cache, k.astype(cdt), (0, offset, 0, 0))
+    v_cache = jax.lax.dynamic_update_slice(v_cache, v.astype(cdt), (0, offset, 0, 0))
+
+    # attend over the cache with absolute-position causal masking
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache).astype(jnp.float32)
+    scores = scores / math.sqrt(Dh)
+    key_pos = jnp.arange(k_cache.shape[1])
+    valid = key_pos[None, :] <= (offset + jnp.arange(S))[:, None]  # (S, max)
+    scores = jnp.where(valid[None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(cdt)
+    attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v_cache).reshape(B, S, D)
+    attn_out = attn @ layer_params["attn"]["wo"].astype(cdt) \
+        + layer_params["attn"]["bo"].astype(cdt)
+
+    if cfg.parallel_residual:
+        mlp_in = layer_norm(x, layer_params["ln2_scale"],
+                            layer_params["ln2_bias"], cfg.layernorm_eps)
+    else:
+        x = x + attn_out
+        mlp_in = layer_norm(x, layer_params["ln2_scale"],
+                            layer_params["ln2_bias"], cfg.layernorm_eps)
+    h = mlp_in @ layer_params["mlp"]["wi"].astype(cdt) \
+        + layer_params["mlp"]["bi"].astype(cdt)
+    h = jax.nn.gelu(h, approximate=True)
+    mlp_out = h @ layer_params["mlp"]["wo"].astype(cdt) \
+        + layer_params["mlp"]["bo"].astype(cdt)
+    x = (x + attn_out + mlp_out) if cfg.parallel_residual else (x + mlp_out)
+    return x, k_cache, v_cache
+
+
+def apply_with_cache(cfg: GPTConfig, params, tokens, cache, offset):
+    """Process S tokens given `offset` already-cached ones. Returns
+    (logits (B, S, V), updated cache)."""
+    cdt = cfg.dtype
+    B, S = tokens.shape
+    wte = params["embed"]["wte"].astype(cdt)
+    x = jnp.take(wte, tokens, axis=0)
+    positions = offset + jnp.arange(S, dtype=jnp.int32)
+    if not cfg.rotary:
+        x = x + jax.lax.dynamic_slice_in_dim(
+            params["embed"]["wpe"], offset, S, axis=0
+        ).astype(cdt)
+
+    def scan_body(carry, xs):
+        x = carry
+        layer_params, k_c, v_c = xs
+        x, k_c, v_c = _cached_block(cfg, x, layer_params, k_c, v_c,
+                                    offset, positions)
+        return x, (k_c, v_c)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        scan_body, x, (params["layers"], cache["k"], cache["v"])
+    )
+    x = layer_norm(x, params["final_ln"]["scale"], params["final_ln"]["bias"],
+                   cfg.layernorm_eps)
+    if cfg.tie_embeddings:
+        logits = x @ params["embed"]["wte"].astype(cdt).T
+    else:
+        logits = x @ params["lm_head"].astype(cdt)
+    return logits, {"k": k_new, "v": v_new}
+
+
+def _select_next(logits, temperature, top_k, rng):
+    """logits (B, V) -> next token (B,). temperature<=0 = greedy."""
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits.astype(jnp.float32) / temperature
+    if top_k is not None:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -1e30, logits)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+def make_generator(cfg: GPTConfig):
+    """Build a jitted generate(params, prompt, max_new_tokens, ...) fn."""
+
+    @partial(jax.jit, static_argnames=("max_new_tokens", "temperature", "top_k"))
+    def generate(params, prompt, max_new_tokens: int, temperature: float = 0.0,
+                 top_k: Optional[int] = None, rng=None):
+        B, S = prompt.shape
+        max_len = S + max_new_tokens
+        if not cfg.rotary and max_len > cfg.max_seq:
+            raise ValueError(
+                f"prompt ({S}) + max_new_tokens ({max_new_tokens}) exceeds "
+                f"max_seq ({cfg.max_seq}) — learned position embeddings "
+                "cannot extrapolate (the wpe slice would clamp silently)"
+            )
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        cache = init_cache(cfg, B, max_len)
+        logits, cache = apply_with_cache(cfg, params, prompt, cache, 0)
+        rng, sub = jax.random.split(rng)
+        next_tok = _select_next(logits[:, -1], temperature, top_k, sub)
+
+        def body(carry, _):
+            tok, cache, offset, rng = carry
+            logits, cache = apply_with_cache(
+                cfg, params, tok[:, None], cache, offset
+            )
+            rng, sub = jax.random.split(rng)
+            nxt = _select_next(logits[:, -1], temperature, top_k, sub)
+            return (nxt, cache, offset + 1, rng), tok
+
+        (last, _, _, _), toks = jax.lax.scan(
+            body, (next_tok, cache, jnp.int32(S), rng), None,
+            length=max_new_tokens - 1,
+        )
+        generated = jnp.concatenate(
+            [jnp.swapaxes(toks, 0, 1), last[:, None]], axis=1
+        )
+        return jnp.concatenate([prompt, generated], axis=1)
+
+    return generate

@@ -221,6 +221,9 @@ class TrainingConfig:
             self.checkpoint_tag_validation_mode != "Ignore"
         )
         self.checkpoint_tag_validation_fail = self.checkpoint_tag_validation_mode == "Fail"
+        self.checkpoint_sharded_io = ckpt.get(
+            c.CHECKPOINT_SHARDED_IO, c.CHECKPOINT_SHARDED_IO_DEFAULT
+        )
         self.load_from_fp32_weights = get_scalar_param(
             pd, c.LOAD_FROM_FP32_WEIGHTS, True
         )

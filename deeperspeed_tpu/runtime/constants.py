@@ -154,6 +154,11 @@ CHECKPOINT = "checkpoint"
 CHECKPOINT_TAG_VALIDATION = "tag_validation"
 CHECKPOINT_TAG_VALIDATION_DEFAULT = "Warn"
 CHECKPOINT_TAG_VALIDATION_MODES = ("Warn", "Ignore", "Fail")
+# orbax-backed per-shard parallel IO: every process writes only its own
+# shards (no full replication gather), and load re-shards to the current
+# mesh — the TPU-scale analog of the reference's per-DP-rank shard files
+CHECKPOINT_SHARDED_IO = "sharded_io"
+CHECKPOINT_SHARDED_IO_DEFAULT = False
 
 LOAD_FROM_FP32_WEIGHTS = "zero_load_from_fp32_weights"
 

@@ -31,10 +31,13 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..checkpoint.serialization import (
+    SHARDED_STATE_DIR,
     CheckpointEngine,
+    load_sharded_tree,
     model_state_filename,
     optim_state_filename,
     read_latest,
+    save_sharded_tree,
     to_host,
     validate_tag_across_processes,
     write_latest,
@@ -943,13 +946,22 @@ class Engine:
                 tag, self._config.checkpoint_tag_validation_fail
             )
         ck = CheckpointEngine(save_dir, tag)
+        if self._config.checkpoint_sharded_io:
+            if self._offload is None:
+                return self._save_checkpoint_sharded(ck, save_dir, tag,
+                                                     client_state, save_latest)
+            logger.warning(
+                "checkpoint.sharded_io ignored: host/NVMe offload keeps the "
+                "optimizer state off-device, so the save uses the legacy "
+                "(replicating) layout"
+            )
         state = self.state
         if jax.process_count() > 1:
             # single-writer layout: replicate device state so every process
             # holds an addressable full copy (a jitted identity with
             # replicated out_shardings = global all-gather), then only
-            # process 0 writes. Per-shard parallel save is the orbax-backed
-            # path, not yet wired.
+            # process 0 writes. The scalable alternative is
+            # checkpoint.sharded_io (orbax per-shard parallel write).
             state = self._fully_replicate(state)
             if jax.process_index() != 0:
                 return True
@@ -983,6 +995,104 @@ class Engine:
         log_dist(f"saved checkpoint {ck.ckpt_dir}", ranks=[0])
         return True
 
+    def _save_checkpoint_sharded(self, ck, save_dir, tag, client_state,
+                                 save_latest):
+        """orbax per-shard parallel write: every process persists only its
+        addressable shards — no replication gather. The scalable analog of
+        the reference's per-DP-rank zero_pp_rank_* files."""
+        state = self.state
+        save_sharded_tree(ck.path(f"{SHARDED_STATE_DIR}/params"), state.params)
+        optim_tree = {
+            "opt_state": state.opt_state,
+            "scaler": state.scaler._asdict(),
+            "step": state.step,
+            "skipped": state.skipped,
+        }
+        if state.master is not None:
+            optim_tree["master"] = state.master
+        save_sharded_tree(ck.path(f"{SHARDED_STATE_DIR}/optim"), optim_tree)
+        if jax.process_index() == 0:
+            meta = {
+                "sharded_io": True,
+                "global_steps": self.global_steps,
+                "global_samples": self.global_samples,
+                "skipped_steps": self.skipped_steps,
+                "micro_steps": self.micro_steps,
+                "dp_world_size": self.data_parallel_size,
+                "mp_world_size": int(self.mesh.shape.get("model", 1)),
+                "zero_stage": self.zero_stage,
+                "lr_scheduler": (
+                    self.lr_scheduler.state_dict() if self.lr_scheduler else {}
+                ),
+                "client_state": client_state or {},
+            }
+            ck.save(model_state_filename(), meta)
+            if save_latest:
+                write_latest(save_dir, tag)
+        log_dist(f"saved sharded checkpoint {ck.ckpt_dir}", ranks=[0])
+        return True
+
+    def _load_checkpoint_sharded(self, ck, load_module_only,
+                                 load_optimizer_states,
+                                 load_lr_scheduler_states):
+        if not ck.exists(model_state_filename()):
+            logger.warning("sharded checkpoint %s has no metadata (partial "
+                           "save?); nothing loaded", ck.ckpt_dir)
+            return None, {}
+        meta = ck.load(model_state_filename())
+        state = self.state
+        # restore the skip counter from metadata up front; a successful
+        # optimizer restore overwrites it with the device value
+        state = state._replace(
+            skipped=jnp.asarray(meta.get("skipped_steps", 0), jnp.int32)
+        )
+        params = load_sharded_tree(
+            ck.path(f"{SHARDED_STATE_DIR}/params"), state.params
+        )
+        state = state._replace(params=params)
+        optim_dir = ck.path(f"{SHARDED_STATE_DIR}/optim")
+        if not load_module_only and load_optimizer_states and os.path.isdir(optim_dir):
+            target = {
+                "opt_state": state.opt_state,
+                "scaler": state.scaler._asdict(),
+                "step": state.step,
+                "skipped": state.skipped,
+            }
+            if state.master is not None:
+                target["master"] = state.master
+            try:
+                restored = load_sharded_tree(optim_dir, target)
+            except Exception as e:
+                logger.warning(
+                    "sharded optimizer restore failed (%s); params-only load "
+                    "— likely a zero-stage/structure change since save", e
+                )
+            else:
+                # scalars replicated over the mesh (the initial state's
+                # scalar leaves may be uncommitted single-device arrays, so
+                # their sharding is not a usable placement target)
+                rep = NamedSharding(self.mesh, P())
+                state = state._replace(
+                    opt_state=restored["opt_state"],
+                    scaler=LossScaleState(**{
+                        k: jax.device_put(v, rep)
+                        for k, v in restored["scaler"].items()
+                    }),
+                    step=jax.device_put(restored["step"], rep),
+                    skipped=jax.device_put(restored["skipped"], rep),
+                )
+                if "master" in restored:
+                    state = state._replace(master=restored["master"])
+        self.state = state
+        self.global_steps = int(meta.get("global_steps", 0))
+        self.global_samples = int(meta.get("global_samples", 0))
+        self.micro_steps = int(meta.get("micro_steps", 0))
+        if (load_lr_scheduler_states and self.lr_scheduler is not None
+                and meta.get("lr_scheduler")):
+            self.lr_scheduler.load_state_dict(meta["lr_scheduler"])
+        log_dist(f"loaded sharded checkpoint {ck.ckpt_dir}", ranks=[0])
+        return ck.ckpt_dir, meta.get("client_state", {})
+
     def load_checkpoint(
         self,
         load_dir,
@@ -997,6 +1107,11 @@ class Engine:
                 logger.warning("no 'latest' file in %s; nothing loaded", load_dir)
                 return None, {}
         ck = CheckpointEngine(load_dir, str(tag))
+        if os.path.isdir(ck.path(SHARDED_STATE_DIR)):
+            return self._load_checkpoint_sharded(
+                ck, load_module_only, load_optimizer_states,
+                load_lr_scheduler_states,
+            )
         if not ck.exists(model_state_filename()):
             logger.warning("checkpoint %s not found", ck.ckpt_dir)
             return None, {}
