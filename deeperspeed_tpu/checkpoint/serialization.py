@@ -45,7 +45,9 @@ def to_host(tree):
 
 
 def save_tree(path: str, tree: Any):
-    os.makedirs(os.path.dirname(path), exist_ok=True)
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
     data = serialization.to_bytes(to_host(tree))
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
@@ -130,11 +132,11 @@ def consolidate_fp32_state(checkpoint_dir: str) -> Dict:
         import orbax.checkpoint as ocp
 
         with ocp.StandardCheckpointer() as ckptr:
-            optim_dir = os.path.join(sharded, "optim")
-            if os.path.isdir(optim_dir):
-                optim = ckptr.restore(os.path.abspath(optim_dir))
-                if isinstance(optim, dict) and optim.get("master"):
-                    return optim["master"]
+            # masters live in their own tree so this read skips the Adam
+            # moments entirely
+            master_dir = os.path.join(sharded, "master")
+            if os.path.isdir(master_dir):
+                return ckptr.restore(os.path.abspath(master_dir))
             return ckptr.restore(os.path.abspath(os.path.join(sharded, "params")))
     for fname in sorted(os.listdir(checkpoint_dir)):
         if fname.startswith("zero_pp_rank_") and fname.endswith(".msgpack"):

@@ -1,0 +1,84 @@
+"""Offline ZeRO-checkpoint -> consolidated fp32 weights tool.
+
+Capability parity with /root/reference/deepspeed/utils/zero_to_fp32.py:70
+(`convert_zero_chkpt_to_fp32_consolid_state_dict`): merge the per-rank
+optimizer shards of a checkpoint directory into one fp32 weight pytree —
+works on both the msgpack layout and the orbax sharded_io layout.
+
+CLI (the engine drops a stub invoking this into every checkpoint dir, as
+the reference copies the script itself):
+
+    python -m deeperspeed_tpu.checkpoint.zero_to_fp32 <ckpt_dir> <out.msgpack>
+"""
+
+import argparse
+import os
+import sys
+
+from .serialization import consolidate_fp32_state, read_latest, save_tree
+
+RECOVERY_SCRIPT = "zero_to_fp32.py"
+
+# self-contained stub written into each checkpoint dir (reference
+# engine.py:1800-1808 copies the tool next to the shards)
+_STUB = """#!/usr/bin/env python
+# Auto-generated recovery stub: consolidate this checkpoint's ZeRO shards
+# into a single fp32 weight file.
+#   python zero_to_fp32.py . pytorch_model.msgpack
+import os, sys
+sys.path.insert(0, {pkg_root!r})
+from deeperspeed_tpu.checkpoint.zero_to_fp32 import main
+if __name__ == "__main__":
+    main()
+"""
+
+
+def write_recovery_stub(ckpt_dir: str):
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    path = os.path.join(ckpt_dir, RECOVERY_SCRIPT)
+    with open(path, "w") as f:
+        f.write(_STUB.format(pkg_root=pkg_root))
+    return path
+
+
+def convert_zero_checkpoint_to_fp32_state_dict(checkpoint_dir: str,
+                                               output_file: str, tag=None):
+    """Reference convert_zero_chkpt_to_fp32_consolid_state_dict."""
+    if tag is None:
+        tag = read_latest(checkpoint_dir)
+    if tag is not None and os.path.isdir(os.path.join(checkpoint_dir, str(tag))):
+        checkpoint_dir = os.path.join(checkpoint_dir, str(tag))
+    state = consolidate_fp32_state(checkpoint_dir)
+    save_tree(output_file, state)
+    return state
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="zero_to_fp32",
+        description="Consolidate ZeRO checkpoint shards into fp32 weights",
+    )
+    parser.add_argument("checkpoint_dir",
+                        help="checkpoint dir (tag dir or parent with 'latest')")
+    parser.add_argument("output_file", help="where to write the fp32 weights")
+    parser.add_argument("-t", "--tag", default=None,
+                        help="checkpoint tag (default: read 'latest')")
+    args = parser.parse_args(argv)
+    state = convert_zero_checkpoint_to_fp32_state_dict(
+        args.checkpoint_dir, args.output_file, tag=args.tag
+    )
+    n = sum(getattr(v, "size", 0) for v in _leaves(state))
+    print(f"wrote {args.output_file} ({n:,} fp32 elements)")
+
+
+def _leaves(tree):
+    if isinstance(tree, dict):
+        for v in tree.values():
+            yield from _leaves(v)
+    else:
+        yield tree
+
+
+if __name__ == "__main__":
+    main()

@@ -26,7 +26,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from .gpt import GPTConfig, layer_norm, rotary_embedding
+from .gpt import GPTConfig, decoder_block, layer_norm
 
 
 def init_cache(cfg: GPTConfig, batch: int, max_len: int):
@@ -43,51 +43,31 @@ def _cached_block(cfg: GPTConfig, x, layer_params, k_cache, v_cache,
     """One decoder layer over S new tokens with a KV cache.
 
     x: (B, S, D); k/v_cache: (B, max_len, H, Dh); offset: scalar — number of
-    tokens already cached. Returns (x_out, k_cache, v_cache).
-
-    This mirrors gpt.make_gpt's block with only the attention KV source
-    changed — keep the two in sync (the prefill/incremental parity tests in
-    tests/test_generation.py fail on any divergence)."""
+    tokens already cached. Returns (x_out, k_cache, v_cache). The layer math
+    is gpt.decoder_block; only the attention core differs (cache update +
+    absolute-position masking)."""
     cdt = cfg.dtype
-    B, S, D = x.shape
-    H, Dh = cfg.n_head, cfg.head_dim
-    attn_in = layer_norm(x, layer_params["ln1_scale"], layer_params["ln1_bias"],
-                         cfg.layernorm_eps)
-    qkv = attn_in @ layer_params["attn"]["wqkv"].astype(cdt) \
-        + layer_params["attn"]["bqkv"].astype(cdt)
-    qkv = qkv.reshape(B, S, 3, H, Dh)
-    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-    if cfg.rotary:
-        rd = int(cfg.rotary_pct * Dh) // 2 * 2
-        q = rotary_embedding(q, positions, rd)
-        k = rotary_embedding(k, positions, rd)
-    k_cache = jax.lax.dynamic_update_slice(k_cache, k.astype(cdt), (0, offset, 0, 0))
-    v_cache = jax.lax.dynamic_update_slice(v_cache, v.astype(cdt), (0, offset, 0, 0))
+    Dh = cfg.head_dim
+    S = x.shape[1]
 
-    # attend over the cache with absolute-position causal masking
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache).astype(jnp.float32)
-    scores = scores / math.sqrt(Dh)
-    key_pos = jnp.arange(k_cache.shape[1])
-    valid = key_pos[None, :] <= (offset + jnp.arange(S))[:, None]  # (S, max)
-    scores = jnp.where(valid[None, None], scores, -1e30)
-    probs = jax.nn.softmax(scores, axis=-1).astype(cdt)
-    attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v_cache).reshape(B, S, D)
-    attn_out = attn @ layer_params["attn"]["wo"].astype(cdt) \
-        + layer_params["attn"]["bo"].astype(cdt)
+    def attend(q, k, v):
+        k_c = jax.lax.dynamic_update_slice(
+            k_cache, k.astype(cdt), (0, offset, 0, 0)
+        )
+        v_c = jax.lax.dynamic_update_slice(
+            v_cache, v.astype(cdt), (0, offset, 0, 0)
+        )
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_c).astype(jnp.float32)
+        scores = scores / math.sqrt(Dh)
+        key_pos = jnp.arange(k_c.shape[1])
+        valid = key_pos[None, :] <= (offset + jnp.arange(S))[:, None]
+        scores = jnp.where(valid[None, None], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(cdt)
+        ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v_c)
+        return ctx, (k_c, v_c)
 
-    if cfg.parallel_residual:
-        mlp_in = layer_norm(x, layer_params["ln2_scale"],
-                            layer_params["ln2_bias"], cfg.layernorm_eps)
-    else:
-        x = x + attn_out
-        mlp_in = layer_norm(x, layer_params["ln2_scale"],
-                            layer_params["ln2_bias"], cfg.layernorm_eps)
-    h = mlp_in @ layer_params["mlp"]["wi"].astype(cdt) \
-        + layer_params["mlp"]["bi"].astype(cdt)
-    h = jax.nn.gelu(h, approximate=True)
-    mlp_out = h @ layer_params["mlp"]["wo"].astype(cdt) \
-        + layer_params["mlp"]["bo"].astype(cdt)
-    x = (x + attn_out + mlp_out) if cfg.parallel_residual else (x + mlp_out)
+    x, (k_cache, v_cache) = decoder_block(cfg, None, x, layer_params,
+                                          positions, attend)
     return x, k_cache, v_cache
 
 
@@ -96,6 +76,13 @@ def apply_with_cache(cfg: GPTConfig, params, tokens, cache, offset):
     (logits (B, S, V), updated cache)."""
     cdt = cfg.dtype
     B, S = tokens.shape
+    if (not cfg.rotary and isinstance(offset, int)
+            and offset + S > cfg.max_seq):
+        # (traced offsets are guarded at the generate() boundary instead)
+        raise ValueError(
+            f"offset ({offset}) + tokens ({S}) exceeds max_seq "
+            f"({cfg.max_seq}): the learned-position table cannot extrapolate"
+        )
     wte = params["embed"]["wte"].astype(cdt)
     x = jnp.take(wte, tokens, axis=0)
     positions = offset + jnp.arange(S, dtype=jnp.int32)
@@ -141,6 +128,8 @@ def make_generator(cfg: GPTConfig):
     def generate(params, prompt, max_new_tokens: int, temperature: float = 0.0,
                  top_k: Optional[int] = None, rng=None):
         B, S = prompt.shape
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
         max_len = S + max_new_tokens
         if not cfg.rotary and max_len > cfg.max_seq:
             raise ValueError(

@@ -232,6 +232,61 @@ def _shard_act(x, mesh, spec):
     )
 
 
+def decoder_block(cfg: GPTConfig, mesh, x, layer_params, positions, attend):
+    """One decoder layer shared by training (make_gpt) and KV-cache decoding
+    (models/generation.py): qkv projection, rotary, residual/MLP wiring.
+
+    ``attend(q, k, v) -> (ctx, aux)`` supplies the attention core — dense /
+    flash / context-parallel for training, cache-updating for decode. Returns
+    (x_out, aux)."""
+    cdt = cfg.dtype
+    B, S, D = x.shape
+    H, Dh = cfg.n_head, cfg.head_dim
+    attn_in = layer_norm(
+        x, layer_params["ln1_scale"], layer_params["ln1_bias"], cfg.layernorm_eps
+    )
+    qkv = attn_in @ layer_params["attn"]["wqkv"].astype(cdt) + layer_params[
+        "attn"
+    ]["bqkv"].astype(cdt)
+    qkv = qkv.reshape(B, S, 3, H, Dh)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    if cfg.rotary:
+        rd = int(cfg.rotary_pct * Dh) // 2 * 2
+        q = rotary_embedding(q, positions, rd)
+        k = rotary_embedding(k, positions, rd)
+    ctx, aux = attend(q, k, v)
+    attn = ctx.reshape(B, S, D)
+    attn_out = attn @ layer_params["attn"]["wo"].astype(cdt) + layer_params[
+        "attn"
+    ]["bo"].astype(cdt)
+
+    if cfg.parallel_residual:
+        # NeoX: x + attn(ln1(x)) + mlp(ln2(x))
+        mlp_in = layer_norm(
+            x, layer_params["ln2_scale"], layer_params["ln2_bias"], cfg.layernorm_eps
+        )
+    else:
+        x = x + attn_out
+        mlp_in = layer_norm(
+            x, layer_params["ln2_scale"], layer_params["ln2_bias"], cfg.layernorm_eps
+        )
+    h = mlp_in @ layer_params["mlp"]["wi"].astype(cdt) + layer_params["mlp"][
+        "bi"
+    ].astype(cdt)
+    h = jax.nn.gelu(h, approximate=True)
+    h = _shard_act(h, mesh, P(DATA_AXIS, SEQ_AXIS, MODEL_AXIS))
+    mlp_out = h @ layer_params["mlp"]["wo"].astype(cdt) + layer_params["mlp"][
+        "bo"
+    ].astype(cdt)
+
+    if cfg.parallel_residual:
+        x = x + attn_out + mlp_out
+    else:
+        x = x + mlp_out
+    x = _shard_act(x, mesh, P(DATA_AXIS, SEQ_AXIS, None))
+    return x, aux
+
+
 def make_gpt(cfg: GPTConfig, mesh=None):
     """Returns (init_fn, apply_fn, loss_fn, specs).
 
@@ -253,65 +308,16 @@ def make_gpt(cfg: GPTConfig, mesh=None):
             mesh, strategy=cfg.attn_impl, causal=True
         )
 
-    def block(carry, layer_params, positions):
-        x = carry  # (B, S, D) compute dtype
-        cdt = cfg.dtype
-        attn_in = layer_norm(
-            x, layer_params["ln1_scale"], layer_params["ln1_bias"], cfg.layernorm_eps
-        )
-        B, S, D = x.shape
-        H, Dh = cfg.n_head, cfg.head_dim
-        qkv = attn_in @ layer_params["attn"]["wqkv"].astype(cdt) + layer_params[
-            "attn"
-        ]["bqkv"].astype(cdt)
-        qkv = qkv.reshape(B, S, 3, H, Dh)
-        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        if cfg.rotary:
-            rd = int(cfg.rotary_pct * Dh) // 2 * 2
-            q = rotary_embedding(q, positions, rd)
-            k = rotary_embedding(k, positions, rd)
+    def attend(q, k, v):
         q = _shard_act(q, mesh, P(DATA_AXIS, SEQ_AXIS, MODEL_AXIS, None))
         k = _shard_act(k, mesh, P(DATA_AXIS, SEQ_AXIS, MODEL_AXIS, None))
         v = _shard_act(v, mesh, P(DATA_AXIS, SEQ_AXIS, MODEL_AXIS, None))
         if cp_attend is not None:
-            attn = cp_attend(q, k, v)
-        else:
-            attn = causal_attention(q, k, v, impl=cfg.attn_impl)
-        attn = attn.reshape(B, S, D)
-        attn_out = attn @ layer_params["attn"]["wo"].astype(cdt) + layer_params[
-            "attn"
-        ]["bo"].astype(cdt)
+            return cp_attend(q, k, v), None
+        return causal_attention(q, k, v, impl=cfg.attn_impl), None
 
-        if cfg.parallel_residual:
-            # NeoX: x + attn(ln1(x)) + mlp(ln2(x))
-            mlp_in = layer_norm(
-                x,
-                layer_params["ln2_scale"],
-                layer_params["ln2_bias"],
-                cfg.layernorm_eps,
-            )
-        else:
-            x = x + attn_out
-            mlp_in = layer_norm(
-                x,
-                layer_params["ln2_scale"],
-                layer_params["ln2_bias"],
-                cfg.layernorm_eps,
-            )
-        h = mlp_in @ layer_params["mlp"]["wi"].astype(cdt) + layer_params["mlp"][
-            "bi"
-        ].astype(cdt)
-        h = jax.nn.gelu(h, approximate=True)
-        h = _shard_act(h, mesh, P(DATA_AXIS, SEQ_AXIS, MODEL_AXIS))
-        mlp_out = h @ layer_params["mlp"]["wo"].astype(cdt) + layer_params["mlp"][
-            "bo"
-        ].astype(cdt)
-
-        if cfg.parallel_residual:
-            x = x + attn_out + mlp_out
-        else:
-            x = x + mlp_out
-        x = _shard_act(x, mesh, P(DATA_AXIS, SEQ_AXIS, None))
+    def block(carry, layer_params, positions):
+        x, _ = decoder_block(cfg, mesh, carry, layer_params, positions, attend)
         return x
 
     def apply_fn(params, tokens):

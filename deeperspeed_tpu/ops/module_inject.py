@@ -1,0 +1,10 @@
+"""Legacy import location (reference keeps a copy of module_inject under
+deepspeed/ops/module_inject.py); the maintained implementation lives in
+deeperspeed_tpu/module_inject/."""
+
+from ..module_inject.replace_module import (  # noqa: F401
+    HFBertLayerPolicy,
+    extract_layer_params,
+    module_inject,
+    replace_transformer_layer,
+)

@@ -992,6 +992,11 @@ class Engine:
         ck.save(optim_state_filename(), optim_states)
         if save_latest and jax.process_index() == 0:
             write_latest(save_dir, tag)
+        # drop the recovery tool next to the shards (reference
+        # engine.py:1800-1808 copies zero_to_fp32.py into the ckpt dir)
+        from ..checkpoint.zero_to_fp32 import write_recovery_stub
+
+        write_recovery_stub(ck.ckpt_dir)
         log_dist(f"saved checkpoint {ck.ckpt_dir}", ranks=[0])
         return True
 
@@ -1008,9 +1013,12 @@ class Engine:
             "step": state.step,
             "skipped": state.skipped,
         }
-        if state.master is not None:
-            optim_tree["master"] = state.master
         save_sharded_tree(ck.path(f"{SHARDED_STATE_DIR}/optim"), optim_tree)
+        if state.master is not None:
+            # masters in their own tree so zero_to_fp32 consolidation can
+            # restore them WITHOUT reading the (2x bigger) Adam moments
+            save_sharded_tree(ck.path(f"{SHARDED_STATE_DIR}/master"),
+                              state.master)
         if jax.process_index() == 0:
             meta = {
                 "sharded_io": True,
@@ -1027,6 +1035,9 @@ class Engine:
                 "client_state": client_state or {},
             }
             ck.save(model_state_filename(), meta)
+            from ..checkpoint.zero_to_fp32 import write_recovery_stub
+
+            write_recovery_stub(ck.ckpt_dir)
             if save_latest:
                 write_latest(save_dir, tag)
         log_dist(f"saved sharded checkpoint {ck.ckpt_dir}", ranks=[0])
@@ -1050,18 +1061,32 @@ class Engine:
             ck.path(f"{SHARDED_STATE_DIR}/params"), state.params
         )
         state = state._replace(params=params)
+        if self._offload is not None:
+            # sharded checkpoints carry no host/NVMe optimizer state; push
+            # the restored params into the offload master so the next step
+            # does not revert them (moments restart — warn loudly)
+            self._offload.set_master_params(params)
+            logger.warning(
+                "sharded checkpoint loaded into an offload engine: params "
+                "restored, optimizer moments reset (sharded_io saves no "
+                "offload state)"
+            )
         optim_dir = ck.path(f"{SHARDED_STATE_DIR}/optim")
-        if not load_module_only and load_optimizer_states and os.path.isdir(optim_dir):
+        master_dir = ck.path(f"{SHARDED_STATE_DIR}/master")
+        optim_restored = False
+        if (not load_module_only and load_optimizer_states
+                and self._offload is None and os.path.isdir(optim_dir)):
             target = {
                 "opt_state": state.opt_state,
                 "scaler": state.scaler._asdict(),
                 "step": state.step,
                 "skipped": state.skipped,
             }
-            if state.master is not None:
-                target["master"] = state.master
             try:
                 restored = load_sharded_tree(optim_dir, target)
+                master = None
+                if state.master is not None and os.path.isdir(master_dir):
+                    master = load_sharded_tree(master_dir, state.master)
             except Exception as e:
                 logger.warning(
                     "sharded optimizer restore failed (%s); params-only load "
@@ -1081,8 +1106,18 @@ class Engine:
                     step=jax.device_put(restored["step"], rep),
                     skipped=jax.device_put(restored["skipped"], rep),
                 )
-                if "master" in restored:
-                    state = state._replace(master=restored["master"])
+                if master is not None:
+                    state = state._replace(master=master)
+                optim_restored = True
+        if not optim_restored and state.master is not None:
+            # params-only load: re-derive the fp32 master from the restored
+            # params, or the first optimizer step would revert them
+            state = state._replace(
+                master=partition.constrain(
+                    jax.tree.map(lambda p: p.astype(jnp.float32), params),
+                    self.master_specs, self.mesh,
+                )
+            )
         self.state = state
         self.global_steps = int(meta.get("global_steps", 0))
         self.global_samples = int(meta.get("global_samples", 0))

@@ -175,6 +175,23 @@ class HostOffloadOptimizer:
                      for k in ("master", "exp_avg", "exp_avg_sq")},
                     async_op=False)
 
+    def set_master_params(self, params):
+        """Overwrite the host fp32 masters from a param pytree (checkpoint
+        restore paths where no offload state was saved; moments keep their
+        current values)."""
+        leaves = jax.tree_util.tree_leaves(params)
+        assert len(leaves) == len(self.names)
+        for name, leaf in zip(self.names, leaves):
+            flat = np.asarray(jax.device_get(leaf), np.float32).ravel()
+            if self.device == "cpu":
+                np.copyto(self._ram[name]["master"], flat)
+            else:
+                buf = self.swapper.swap_in(name, async_op=False)
+                states = {k: v.copy() for k, v in
+                          self.swapper.unpack(name, buf).items()}
+                states["master"] = np.ascontiguousarray(flat)
+                self.swapper.swap_out(name, states, async_op=False)
+
     def current_params(self):
         """Materialize the compute-dtype param pytree from the master copy
         (used on checkpoint load to refresh device params)."""
