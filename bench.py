@@ -61,7 +61,9 @@ def main():
             vocab_size=50304, n_layer=12, n_head=12, d_model=768, max_seq=1024,
             remat=False,  # flash attention keeps activations O(S); 125M fits
         )
-        micro, seq, steps, warmup = 8, 1024, 20, 3
+        # micro=12 measured best on the 16GB-HBM chip (probes: mb8 69.4k,
+        # mb12 71.1k, mb16+selective-remat 63.7k tok/s; mb16 no-remat OOMs)
+        micro, seq, steps, warmup = 12, 1024, 20, 3
     else:  # smoke mode off-TPU
         cfg = GPTConfig(
             vocab_size=1024, n_layer=2, n_head=4, d_model=128, max_seq=128,
@@ -71,30 +73,41 @@ def main():
 
     init_fn, _, loss_fn, _ = make_gpt(cfg)
     params = init_fn(jax.random.PRNGKey(0))
-    ds_cfg = {
-        "train_micro_batch_size_per_gpu": micro,
-        "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
-        "fp16": {"enabled": True, "type": "bfloat16"},
-        "zero_optimization": {"stage": 1},
-        "gradient_clipping": 1.0,
-        "steps_per_print": 10**9,
-    }
-    engine, _, _, _ = ds.initialize(
-        model=loss_fn, model_parameters=params, config=ds_cfg
-    )
-    dp = engine.data_parallel_size
-    rng = np.random.default_rng(0)
-    batch = rng.integers(0, cfg.vocab_size, size=(micro * dp, seq + 1), dtype=np.int32)
 
-    for _ in range(warmup):
-        loss = engine.train_batch(batch)
-    # device_get is the only reliable barrier on the axon-tunneled platform
-    float(jax.device_get(loss))
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = engine.train_batch(batch)
-    float(jax.device_get(loss))
-    dt = (time.perf_counter() - t0) / steps
+    def run_at(micro, steps, warmup):
+        """Build an engine at this micro batch and time steps/sec."""
+        ds_cfg = {
+            "train_micro_batch_size_per_gpu": micro,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+            "fp16": {"enabled": True, "type": "bfloat16"},
+            "zero_optimization": {"stage": 1},
+            "gradient_clipping": 1.0,
+            "steps_per_print": 10**9,
+        }
+        engine, _, _, _ = ds.initialize(
+            model=loss_fn, model_parameters=params, config=ds_cfg
+        )
+        dp = engine.data_parallel_size
+        rng = np.random.default_rng(0)
+        batch = rng.integers(
+            0, cfg.vocab_size, size=(micro * dp, seq + 1), dtype=np.int32
+        )
+        for _ in range(warmup):
+            loss = engine.train_batch(batch)
+        # device_get is the only reliable barrier on the axon-tunneled platform
+        float(jax.device_get(loss))
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = engine.train_batch(batch)
+        float(jax.device_get(loss))
+        dt = (time.perf_counter() - t0) / steps
+        return dt, dp, loss
+
+    # NOTE: no in-process micro-batch sweep — sequential engines in one
+    # process do not reliably release HBM on the tunneled platform, which
+    # corrupts later measurements. The micro batch is tuned offline.
+    micro = int(os.environ.get("DS_BENCH_MICRO", micro)) if on_tpu else micro
+    dt, dp, loss = run_at(micro, steps, warmup)
 
     tokens_per_step = micro * dp * seq
     tokens_per_sec_per_chip = tokens_per_step / dt / max(1, len(jax.devices()))
@@ -109,6 +122,7 @@ def main():
                 "unit": "tokens/s/chip",
                 "vs_baseline": round(mfu / REFERENCE_MFU, 4),
                 "detail": {
+                    "micro_batch": micro,
                     "step_time_s": round(dt, 4),
                     "model_tflops_per_chip": round(model_tflops, 2),
                     "mfu": round(mfu, 4),

@@ -137,6 +137,12 @@ def consolidate_fp32_state(checkpoint_dir: str) -> Dict:
             master_dir = os.path.join(sharded, "master")
             if os.path.isdir(master_dir):
                 return ckptr.restore(os.path.abspath(master_dir))
+            # older sharded layout kept the master inside the optim tree
+            optim_dir = os.path.join(sharded, "optim")
+            if os.path.isdir(optim_dir):
+                optim = ckptr.restore(os.path.abspath(optim_dir))
+                if isinstance(optim, dict) and optim.get("master") is not None:
+                    return optim["master"]
             return ckptr.restore(os.path.abspath(os.path.join(sharded, "params")))
     for fname in sorted(os.listdir(checkpoint_dir)):
         if fname.startswith("zero_pp_rank_") and fname.endswith(".msgpack"):

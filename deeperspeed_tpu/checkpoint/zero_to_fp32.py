@@ -25,9 +25,14 @@ _STUB = """#!/usr/bin/env python
 # Auto-generated recovery stub: consolidate this checkpoint's ZeRO shards
 # into a single fp32 weight file.
 #   python zero_to_fp32.py . pytorch_model.msgpack
+# Needs the deeperspeed_tpu package importable (pip-installed or on
+# PYTHONPATH); the saver's install path is tried as a fallback.
 import os, sys
-sys.path.insert(0, {pkg_root!r})
-from deeperspeed_tpu.checkpoint.zero_to_fp32 import main
+try:
+    from deeperspeed_tpu.checkpoint.zero_to_fp32 import main
+except ImportError:
+    sys.path.insert(0, {pkg_root!r})
+    from deeperspeed_tpu.checkpoint.zero_to_fp32 import main
 if __name__ == "__main__":
     main()
 """

@@ -48,10 +48,21 @@ class GPTConfig:
     layernorm_eps: float = 1e-5
     tie_embeddings: bool = False
     remat: bool = True
+    # remat policy: 'full' recomputes everything (min memory);
+    # 'dots' saves matmul outputs (recomputes only elementwise — much
+    # cheaper backward at a modest memory cost)
+    remat_policy: str = "full"
     dtype: Any = jnp.bfloat16  # compute dtype for activations
     # 'auto' | 'pallas' | 'xla' | 'ring' | 'ulysses' (the last two are the
     # context-parallel paths over the 'seq' mesh axis)
     attn_impl: str = "auto"
+
+    def __post_init__(self):
+        if self.remat_policy not in ("full", "dots"):
+            raise ValueError(
+                f"remat_policy must be 'full' or 'dots', got "
+                f"{self.remat_policy!r}"
+            )
 
     @property
     def ffn_dim(self):
@@ -333,7 +344,9 @@ def make_gpt(cfg: GPTConfig, mesh=None):
 
         step = partial(block, positions=positions)
         if cfg.remat:
-            step = jax.checkpoint(step, prevent_cse=False)
+            policy = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+                      if cfg.remat_policy == "dots" else None)
+            step = jax.checkpoint(step, prevent_cse=False, policy=policy)
 
         def scan_body(carry, xs):
             layer_params, layer_idx = xs

@@ -243,6 +243,15 @@ class TrainingConfig:
 
         self.gradient_noise_scale = pd.get(c.GRADIENT_NOISE_SCALE, None)
 
+    def get_sparse_attention(self, num_heads: int):
+        """Build the configured SparsityConfig (reference runtime/config.py:213
+        get_sparse_attention); None when the block is absent."""
+        if not self.sparse_attention:
+            return None
+        from ..ops.sparse_attention import sparsity_config_from_dict
+
+        return sparsity_config_from_dict(num_heads, self.sparse_attention)
+
     # ------------------------------------------------------------------ #
 
     def _batch_assertion(self):

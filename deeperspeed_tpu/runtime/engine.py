@@ -993,10 +993,12 @@ class Engine:
         if save_latest and jax.process_index() == 0:
             write_latest(save_dir, tag)
         # drop the recovery tool next to the shards (reference
-        # engine.py:1800-1808 copies zero_to_fp32.py into the ckpt dir)
-        from ..checkpoint.zero_to_fp32 import write_recovery_stub
+        # engine.py:1800-1808 copies zero_to_fp32.py into the ckpt dir);
+        # single writer — the open() is a plain truncate
+        if jax.process_index() == 0:
+            from ..checkpoint.zero_to_fp32 import write_recovery_stub
 
-        write_recovery_stub(ck.ckpt_dir)
+            write_recovery_stub(ck.ckpt_dir)
         log_dist(f"saved checkpoint {ck.ckpt_dir}", ranks=[0])
         return True
 
@@ -1074,6 +1076,7 @@ class Engine:
         optim_dir = ck.path(f"{SHARDED_STATE_DIR}/optim")
         master_dir = ck.path(f"{SHARDED_STATE_DIR}/master")
         optim_restored = False
+        master_restored = False
         if (not load_module_only and load_optimizer_states
                 and self._offload is None and os.path.isdir(optim_dir)):
             target = {
@@ -1082,9 +1085,14 @@ class Engine:
                 "step": state.step,
                 "skipped": state.skipped,
             }
+            legacy_master = (state.master is not None
+                             and not os.path.isdir(master_dir))
+            if legacy_master:
+                # older sharded layout stored the master inside the optim tree
+                target["master"] = state.master
             try:
                 restored = load_sharded_tree(optim_dir, target)
-                master = None
+                master = restored.pop("master", None)
                 if state.master is not None and os.path.isdir(master_dir):
                     master = load_sharded_tree(master_dir, state.master)
             except Exception as e:
@@ -1108,10 +1116,12 @@ class Engine:
                 )
                 if master is not None:
                     state = state._replace(master=master)
+                    master_restored = True
                 optim_restored = True
-        if not optim_restored and state.master is not None:
-            # params-only load: re-derive the fp32 master from the restored
-            # params, or the first optimizer step would revert them
+        if state.master is not None and not master_restored:
+            # no master came off disk (params-only load, or a checkpoint
+            # saved without one): re-derive it from the restored params, or
+            # the first optimizer step would revert them
             state = state._replace(
                 master=partition.constrain(
                     jax.tree.map(lambda p: p.astype(jnp.float32), params),

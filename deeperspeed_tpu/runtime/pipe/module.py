@@ -85,6 +85,11 @@ class Embedding(Layer):
 def _as_layer(obj) -> Layer:
     if isinstance(obj, Layer):
         return obj
+    # duck-typed functional layers (e.g. ops.transformer's
+    # DeepSpeedTransformerLayer, TP layers) expose init/apply without
+    # subclassing Layer
+    if hasattr(obj, "init") and hasattr(obj, "apply"):
+        return obj
     if callable(obj):
         return FnLayer(obj)
     raise TypeError(f"not a pipeline layer: {obj!r}")

@@ -246,6 +246,41 @@ def test_sharded_load_into_offload_engine(tmp_path):
     assert not np.allclose(stepped, np.asarray(params["w"], np.float32))
 
 
+def test_sharded_fp32_save_into_bf16_engine(tmp_path):
+    """Checkpoint saved by an fp32 engine (no master tree) loaded into a
+    bf16 engine (which keeps one): the master must be re-derived from the
+    restored params, not left at init values."""
+    engine, _ = _sharded_engine(stage=1)  # fp32: state.master is None
+    assert engine.state.master is None
+    for i in range(3):
+        engine.train_batch(batch=_batch84(i))
+    engine.save_checkpoint(str(tmp_path))
+    saved_w = np.asarray(engine.state.params["w"], np.float32)
+
+    cfg = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 1},
+        "bf16": {"enabled": True},
+        "checkpoint": {"sharded_io": True},
+    }
+    params = {"w": jax.random.normal(jax.random.PRNGKey(5), (8, 4)) * 0.1}
+    bf16_engine, _, _, _ = deepspeed.initialize(
+        model=_loss_fn, model_parameters=params, config_params=cfg
+    )
+    assert bf16_engine.state.master is not None
+    bf16_engine.load_checkpoint(str(tmp_path))
+    np.testing.assert_allclose(
+        np.asarray(bf16_engine.state.master["w"], np.float32), saved_w,
+        rtol=1e-2, atol=1e-2)  # master re-derived from restored bf16 params
+    # next step moves FROM the restored weights, not back to init
+    bf16_engine.train_batch(batch=_batch84(0))
+    stepped = np.asarray(bf16_engine.state.params["w"], np.float32)
+    assert np.abs(stepped - saved_w).max() < 0.1
+    assert not np.allclose(stepped, np.asarray(params["w"], np.float32),
+                           atol=1e-3)
+
+
 def test_zero_to_fp32_cli_and_recovery_stub(tmp_path):
     import subprocess
     import sys

@@ -1,0 +1,109 @@
+"""Multi-output model tests (reference tests/unit/test_multi_output_model.py
+analog: models returning several losses/outputs train correctly) plus
+PipelineModule-of-fused-transformer-layers integration."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deeperspeed_tpu as deepspeed
+
+
+def test_two_loss_model_trains():
+    """loss_fn returning (total, aux) — the engine trains on total and
+    ignores aux (reference multi-output models sum weighted losses)."""
+
+    def loss_fn(params, batch):
+        x, y1, y2 = batch
+        h = jnp.tanh(x @ params["w1"])
+        out1 = h @ params["head1"]
+        out2 = h @ params["head2"]
+        l1 = jnp.mean((out1 - y1) ** 2)
+        l2 = jnp.mean((out2 - y2) ** 2)
+        total = 1.0 * l1 + 0.5 * l2
+        return total, {"l1": l1, "l2": l2}
+
+    rngs = jax.random.split(jax.random.PRNGKey(0), 3)
+    params = {
+        "w1": jax.random.normal(rngs[0], (8, 16)) * 0.3,
+        "head1": jax.random.normal(rngs[1], (16, 2)) * 0.3,
+        "head2": jax.random.normal(rngs[2], (16, 3)) * 0.3,
+    }
+    engine, _, _, _ = deepspeed.initialize(
+        model=loss_fn, model_parameters=params,
+        config_params={"train_batch_size": 16,
+                       "gradient_accumulation_steps": 2,
+                       "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                       "zero_optimization": {"stage": 2}},
+    )
+    rs = np.random.RandomState(0)
+    x = rs.randn(16, 8).astype(np.float32)
+    y1 = rs.randn(16, 2).astype(np.float32)
+    y2 = rs.randn(16, 3).astype(np.float32)
+    batch = (jnp.asarray(x), jnp.asarray(y1), jnp.asarray(y2))
+    l0 = float(engine.train_batch(batch=batch))
+    for _ in range(30):
+        l = float(engine.train_batch(batch=batch))
+    assert l < l0 / 1.5
+
+
+def test_config_get_sparse_attention():
+    from deeperspeed_tpu.ops.sparse_attention import BigBirdSparsityConfig
+    from deeperspeed_tpu.runtime.config import TrainingConfig
+
+    tc = TrainingConfig({
+        "train_batch_size": 8,
+        "sparse_attention": {"mode": "bigbird", "block": 16,
+                             "num_random_blocks": 1,
+                             "num_sliding_window_blocks": 3,
+                             "num_global_blocks": 1},
+    })
+    sc = tc.get_sparse_attention(num_heads=4)
+    assert isinstance(sc, BigBirdSparsityConfig)
+    assert sc.block == 16
+    assert TrainingConfig({"train_batch_size": 8}).get_sparse_attention(4) is None
+
+
+def test_pipeline_of_fused_transformer_layers():
+    """PipelineModule whose stages are DeepSpeedTransformerLayers — the
+    fused kernel layer composes with the pipe engine (reference pairs the
+    CUDA layer with PipelineModule the same way)."""
+    from deeperspeed_tpu import build_mesh, initialize
+    from deeperspeed_tpu.ops.transformer import DeepSpeedTransformerConfig
+    from deeperspeed_tpu.ops.transformer import DeepSpeedTransformerLayer
+    from deeperspeed_tpu.runtime.pipe.module import LayerSpec, PipelineModule
+
+    conf = DeepSpeedTransformerConfig(
+        hidden_size=16, heads=2, intermediate_size=32,
+        attn_dropout_ratio=0.0, hidden_dropout_ratio=0.0,
+        pre_layer_norm=True, attn_impl="xla", num_hidden_layers=4,
+    )
+
+    def mse(out, target):
+        return jnp.mean((out - target) ** 2)
+
+    module = PipelineModule(
+        layers=[LayerSpec(DeepSpeedTransformerLayer, conf) for _ in range(4)],
+        num_stages=2,
+        loss_fn=mse,
+    )
+    mesh = build_mesh({"pipe": 2, "data": 2}, devices=jax.devices()[:4])
+    engine, _, _, _ = initialize(
+        model=module, mesh=mesh,
+        config_params={"train_batch_size": 8,
+                       "train_micro_batch_size_per_gpu": 2,
+                       "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}},
+    )
+    rs = np.random.RandomState(0)
+    x = rs.randn(4, 8, 16).astype(np.float32)
+    y = rs.randn(4, 8, 16).astype(np.float32)
+
+    def batches():
+        while True:
+            yield (jnp.asarray(x), jnp.asarray(y))
+
+    l0 = float(engine.train_batch(batches()))
+    for _ in range(15):
+        l = float(engine.train_batch(batches()))
+    assert np.isfinite(l) and l < l0
