@@ -137,9 +137,12 @@ def consolidate_fp32_state(checkpoint_dir: str) -> Dict:
             master_dir = os.path.join(sharded, "master")
             if os.path.isdir(master_dir):
                 return ckptr.restore(os.path.abspath(master_dir))
-            # older sharded layout kept the master inside the optim tree
+            # older sharded layout kept the master inside the optim tree —
+            # probe the manifest first so new-layout checkpoints never pay
+            # the moments' IO
             optim_dir = os.path.join(sharded, "optim")
-            if os.path.isdir(optim_dir):
+            if (os.path.isdir(optim_dir)
+                    and "master" in sharded_tree_top_keys(optim_dir)):
                 optim = ckptr.restore(os.path.abspath(optim_dir))
                 if isinstance(optim, dict) and optim.get("master") is not None:
                     return optim["master"]
@@ -168,6 +171,24 @@ def consolidate_fp32_state(checkpoint_dir: str) -> Dict:
 # ---------------------------------------------------------------------------
 
 SHARDED_STATE_DIR = "sharded_state"
+
+
+def sharded_tree_top_keys(path: str) -> set:
+    """Top-level keys of an orbax tree WITHOUT restoring it: parsed from the
+    on-disk _METADATA manifest (keys are stringified key paths)."""
+    import json
+
+    meta_file = os.path.join(path, "_METADATA")
+    if not os.path.isfile(meta_file):
+        return set()
+    with open(meta_file) as f:
+        md = json.load(f)
+    tops = set()
+    for key_path in md.get("tree_metadata", {}):
+        first = key_path.strip("()").split(",")[0].strip().strip("'\"")
+        if first:
+            tops.add(first)
+    return tops
 
 
 def save_sharded_tree(path: str, tree: Any):

@@ -38,6 +38,7 @@ from ..checkpoint.serialization import (
     optim_state_filename,
     read_latest,
     save_sharded_tree,
+    sharded_tree_top_keys,
     to_host,
     validate_tag_across_processes,
     write_latest,
@@ -1085,10 +1086,11 @@ class Engine:
                 "step": state.step,
                 "skipped": state.skipped,
             }
-            legacy_master = (state.master is not None
-                             and not os.path.isdir(master_dir))
-            if legacy_master:
-                # older sharded layout stored the master inside the optim tree
+            if (state.master is not None and not os.path.isdir(master_dir)
+                    and "master" in sharded_tree_top_keys(optim_dir)):
+                # older sharded layout stored the master inside the optim
+                # tree; a checkpoint with no master anywhere (fp32 saver)
+                # must NOT get the key injected or the whole restore fails
                 target["master"] = state.master
             try:
                 restored = load_sharded_tree(optim_dir, target)

@@ -269,10 +269,17 @@ def test_sharded_fp32_save_into_bf16_engine(tmp_path):
         model=_loss_fn, model_parameters=params, config_params=cfg
     )
     assert bf16_engine.state.master is not None
+    before_step = int(jax.device_get(bf16_engine.state.step))
     bf16_engine.load_checkpoint(str(tmp_path))
     np.testing.assert_allclose(
         np.asarray(bf16_engine.state.master["w"], np.float32), saved_w,
         rtol=1e-2, atol=1e-2)  # master re-derived from restored bf16 params
+    # the optimizer state itself must still restore (moments + step); the
+    # missing master tree must not poison the whole optim restore
+    assert int(jax.device_get(bf16_engine.state.step)) == 3 != before_step
+    np.testing.assert_allclose(
+        np.asarray(bf16_engine.state.opt_state.exp_avg["w"]),
+        np.asarray(engine.state.opt_state.exp_avg["w"]), rtol=1e-5)
     # next step moves FROM the restored weights, not back to init
     bf16_engine.train_batch(batch=_batch84(0))
     stepped = np.asarray(bf16_engine.state.params["w"], np.float32)

@@ -1,0 +1,103 @@
+"""Model-level functional tests (reference tests/model/Megatron_GPT2/
+run_func_test.py analog): train a small GPT under each framework config —
+baseline, ZeRO 1/2/3, gradient accumulation, cpu offload, PLD — and compare
+the loss trajectories against the baseline run, mirroring the reference's
+"grep LM loss and compare" methodology with in-process tolerance checks."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deeperspeed_tpu as deepspeed
+from deeperspeed_tpu.models.gpt import GPTConfig, make_gpt
+
+STEPS = 12
+SEQ = 32
+MICRO = 2  # per-chip
+
+
+def _model():
+    cfg = GPTConfig(vocab_size=256, n_layer=2, n_head=2, d_model=64,
+                    max_seq=SEQ, remat=False, dtype=jnp.float32,
+                    attn_impl="xla", rotary=True)
+    return make_gpt(cfg)
+
+
+def _data(batch_rows, seed=0):
+    # fixed token stream with learnable structure (periodic sequences)
+    rs = np.random.RandomState(seed)
+    base = rs.randint(0, 256, size=(batch_rows * STEPS, SEQ + 1)).astype(np.int32)
+    base[:, 1::2] = base[:, :-1:2]  # every odd position copies its neighbor
+    return base
+
+
+def _losses(extra_config, gas=1, seed=0):
+    init_fn, _, loss_fn, _ = _model()
+    params = init_fn(jax.random.PRNGKey(seed))
+    cfg = {
+        "train_micro_batch_size_per_gpu": MICRO,
+        "gradient_accumulation_steps": gas,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "steps_per_print": 10**9,
+    }
+    cfg.update(extra_config)
+    engine, _, _, _ = deepspeed.initialize(
+        model=loss_fn, model_parameters=params, config_params=cfg
+    )
+    rows = MICRO * engine.data_parallel_size * gas
+    data = _data(rows)
+    losses = []
+    for i in range(STEPS):
+        batch = jnp.asarray(data[i * rows:(i + 1) * rows])
+        losses.append(float(engine.train_batch(batch=batch)))
+    return losses
+
+
+@pytest.fixture(scope="module")
+def baseline_losses():
+    return _losses({})
+
+
+def _check(losses, baseline, rtol):
+    assert losses[-1] < losses[0], "loss did not decrease"
+    np.testing.assert_allclose(losses, baseline, rtol=rtol, atol=5e-3)
+
+
+@pytest.mark.parametrize("stage", [1, 2, 3])
+def test_zero_stage_matches_baseline(stage, baseline_losses):
+    losses = _losses({"zero_optimization": {"stage": stage}})
+    _check(losses, baseline_losses, rtol=2e-3)
+
+
+def test_gradient_accumulation_matches_baseline(baseline_losses):
+    # same global batch split into 2 microbatches; the loss trajectory must
+    # track the baseline closely (reference ds_config gas configs)
+    init_losses = _losses({}, gas=2)
+    assert init_losses[-1] < init_losses[0]
+    # per-step loss is the mean over the same samples -> comparable
+    np.testing.assert_allclose(init_losses[:3], baseline_losses[:3], rtol=0.2)
+
+
+def test_cpu_offload_matches_baseline(baseline_losses):
+    losses = _losses({
+        "zero_optimization": {"stage": 2,
+                              "offload_optimizer": {"device": "cpu"}},
+    })
+    _check(losses, baseline_losses, rtol=5e-3)
+
+
+def test_bf16_tracks_baseline(baseline_losses):
+    losses = _losses({"bf16": {"enabled": True}})
+    # low precision: trajectory tracks loosely but trains
+    assert losses[-1] < losses[0]
+    np.testing.assert_allclose(losses, baseline_losses, rtol=0.1, atol=0.1)
+
+
+def test_pld_trains():
+    losses = _losses({
+        "progressive_layer_drop": {"enabled": True, "theta": 0.5,
+                                   "gamma": 0.01},
+    })
+    # PLD changes dynamics; only require healthy training
+    assert np.isfinite(losses).all()
