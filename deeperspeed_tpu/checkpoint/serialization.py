@@ -141,8 +141,9 @@ def consolidate_fp32_state(checkpoint_dir: str) -> Dict:
             # probe the manifest first so new-layout checkpoints never pay
             # the moments' IO
             optim_dir = os.path.join(sharded, "optim")
-            if (os.path.isdir(optim_dir)
-                    and "master" in sharded_tree_top_keys(optim_dir)):
+            optim_keys = sharded_tree_top_keys(optim_dir)
+            if os.path.isdir(optim_dir) and (
+                    optim_keys is None or "master" in optim_keys):
                 optim = ckptr.restore(os.path.abspath(optim_dir))
                 if isinstance(optim, dict) and optim.get("master") is not None:
                     return optim["master"]
@@ -173,18 +174,23 @@ def consolidate_fp32_state(checkpoint_dir: str) -> Dict:
 SHARDED_STATE_DIR = "sharded_state"
 
 
-def sharded_tree_top_keys(path: str) -> set:
+def sharded_tree_top_keys(path: str) -> Optional[set]:
     """Top-level keys of an orbax tree WITHOUT restoring it: parsed from the
-    on-disk _METADATA manifest (keys are stringified key paths)."""
+    on-disk _METADATA manifest (keys are stringified key paths). Returns
+    None when no manifest is readable — 'unknown', NOT 'empty': callers must
+    fall back to attempt-and-see behavior rather than assume a key is
+    absent."""
     import json
 
     meta_file = os.path.join(path, "_METADATA")
-    if not os.path.isfile(meta_file):
-        return set()
-    with open(meta_file) as f:
-        md = json.load(f)
+    try:
+        with open(meta_file) as f:
+            md = json.load(f)
+        tree_md = md["tree_metadata"]
+    except (OSError, ValueError, KeyError):
+        return None
     tops = set()
-    for key_path in md.get("tree_metadata", {}):
+    for key_path in tree_md:
         first = key_path.strip("()").split(",")[0].strip().strip("'\"")
         if first:
             tops.add(first)

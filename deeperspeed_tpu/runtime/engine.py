@@ -124,6 +124,28 @@ class Engine:
                 gamma=pld_params.get("gamma", 0.001),
             )
         self._takes_pld = _loss_fn_takes_pld(model)
+        # batch-size warmup scheduler (fork bs_schedules.py). The engine
+        # tracks the schedule and exposes current_batch_size(); the data
+        # pipeline reads it — on TPU the array SHAPES stay fixed (no
+        # retrace) and the loader masks/subsets rows.
+        self.batch_size_scheduler = None
+        if config.batch_scheduler_enabled:
+            from .bs_schedules import BatchSizeScheduler
+
+            known = ("final_batch_size", "min_batch_size_multiplier",
+                     "warmup_num_steps", "num_intervals",
+                     "last_batch_iteration")
+            bs_params = {k: v for k, v in config.batch_scheduler_params.items()
+                         if k in known}
+            unknown = set(config.batch_scheduler_params) - set(known) - {"enabled"}
+            if unknown:
+                raise ValueError(
+                    f"batch_scheduler config has unknown keys {sorted(unknown)}; "
+                    f"valid keys: {list(known)}"
+                )
+            bs_params.setdefault("final_batch_size", config.train_batch_size)
+            self.batch_size_scheduler = BatchSizeScheduler(**bs_params)
+            self.batch_size_scheduler.step(0)
         self._compute_dtype = _dtype_of(config.precision)
         self.zero_stage = config.zero_optimization_stage
 
@@ -356,6 +378,13 @@ class Engine:
     # ------------------------------------------------------------------ #
 
     def train_batch_size(self):
+        return self._config.train_batch_size
+
+    def current_batch_size(self):
+        """Scheduled effective batch size (== train_batch_size unless a
+        batch_scheduler block is configured)."""
+        if self.batch_size_scheduler is not None:
+            return self.batch_size_scheduler.current_batch_size
         return self._config.train_batch_size
 
     def train_micro_batch_size_per_gpu(self):
@@ -749,9 +778,11 @@ class Engine:
         the host must know whether to step the lr scheduler; the bf16/fp32 hot
         path stays fully async (overflow still discards the update on device)."""
         self.global_steps += 1
-        self.global_samples += self.train_batch_size()
+        self.global_samples += self.current_batch_size()
         if self.progressive_layer_drop is not None:
             self.progressive_layer_drop.update_state(self.global_steps)
+        if self.batch_size_scheduler is not None:
+            self.batch_size_scheduler.step(self.global_steps)
         if self.summary_writer is not None:
             # write the PREVIOUS step's scalars (its device values have
             # completed, so device_get doesn't stall the pipeline — keeps
@@ -1086,11 +1117,14 @@ class Engine:
                 "step": state.step,
                 "skipped": state.skipped,
             }
+            optim_keys = sharded_tree_top_keys(optim_dir)
             if (state.master is not None and not os.path.isdir(master_dir)
-                    and "master" in sharded_tree_top_keys(optim_dir)):
+                    and (optim_keys is None or "master" in optim_keys)):
                 # older sharded layout stored the master inside the optim
                 # tree; a checkpoint with no master anywhere (fp32 saver)
-                # must NOT get the key injected or the whole restore fails
+                # must NOT get the key injected or the whole restore fails.
+                # Unreadable manifest (None) falls back to attempting the
+                # legacy shape.
                 target["master"] = state.master
             try:
                 restored = load_sharded_tree(optim_dir, target)
@@ -1132,6 +1166,8 @@ class Engine:
             )
         self.state = state
         self.global_steps = int(meta.get("global_steps", 0))
+        if self.batch_size_scheduler is not None:
+            self.batch_size_scheduler.step(self.global_steps)
         self.global_samples = int(meta.get("global_samples", 0))
         self.micro_steps = int(meta.get("micro_steps", 0))
         if (load_lr_scheduler_states and self.lr_scheduler is not None
@@ -1228,6 +1264,8 @@ class Engine:
         )
         self.state = state
         self.global_steps = int(model_states.get("global_steps", 0))
+        if self.batch_size_scheduler is not None:
+            self.batch_size_scheduler.step(self.global_steps)
         self.global_samples = int(model_states.get("global_samples", 0))
         self.micro_steps = int(model_states.get("micro_steps", 0))
         if (

@@ -119,6 +119,33 @@ def test_transformer_stochastic_mode_gating():
     np.testing.assert_allclose(np.asarray(skip), np.asarray(x), atol=1e-6)
 
 
+def test_engine_batch_size_scheduler_wiring():
+    def loss_fn(params, batch):
+        x, y = batch
+        return jnp.mean((x @ params["w"] - y) ** 2)
+
+    engine, _, _, _ = deepspeed.initialize(
+        model=loss_fn, model_parameters={"w": jnp.zeros((4, 1))},
+        config_params={
+            "train_batch_size": 16,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "batch_scheduler": {"enabled": True,
+                                "min_batch_size_multiplier": 0.25,
+                                "warmup_num_steps": 4,
+                                "num_intervals": 4},
+        },
+    )
+    assert engine.current_batch_size() == 4  # 0.25 * 16 at step 0
+    x = np.random.RandomState(0).randn(16, 4).astype(np.float32)
+    y = np.random.RandomState(1).randn(16, 1).astype(np.float32)
+    sizes = [engine.current_batch_size()]
+    for _ in range(6):
+        engine.train_batch(batch=(jnp.asarray(x), jnp.asarray(y)))
+        sizes.append(engine.current_batch_size())
+    assert sizes[-1] == 16  # warmup complete
+    assert all(b >= a for a, b in zip(sizes, sizes[1:]))
+
+
 def test_batch_size_scheduler():
     sched = BatchSizeScheduler(final_batch_size=16, num_intervals=8,
                                warmup_num_steps=100,
