@@ -144,7 +144,10 @@ def consolidate_fp32_state(checkpoint_dir: str) -> Dict:
             optim_keys = sharded_tree_top_keys(optim_dir)
             if os.path.isdir(optim_dir) and (
                     optim_keys is None or "master" in optim_keys):
-                optim = ckptr.restore(os.path.abspath(optim_dir))
+                try:
+                    optim = ckptr.restore(os.path.abspath(optim_dir))
+                except Exception:
+                    optim = None  # partial/corrupt optim dir: params below
                 if isinstance(optim, dict) and optim.get("master") is not None:
                     return optim["master"]
             return ckptr.restore(os.path.abspath(os.path.join(sharded, "params")))

@@ -145,7 +145,10 @@ class Engine:
                 )
             bs_params.setdefault("final_batch_size", config.train_batch_size)
             self.batch_size_scheduler = BatchSizeScheduler(**bs_params)
-            self.batch_size_scheduler.step(0)
+            # honor a configured resume point; default starts at step 0
+            self.batch_size_scheduler.step(
+                max(bs_params.get("last_batch_iteration", 0), 0)
+            )
         self._compute_dtype = _dtype_of(config.precision)
         self.zero_stage = config.zero_optimization_stage
 
@@ -726,7 +729,14 @@ class Engine:
         fpc = self._config.flops_profiler_config
         if fpc.enabled and not getattr(self, "_flops_profiled", False):
             self._profile_args = (batch, rng)
+        wall = self._config.wall_clock_breakdown
+        if wall:
+            self.timers(FORWARD_MICRO_TIMER).start()
         loss, grads = self._forward_grad_fn()(self.state, batch, rng)
+        if wall:
+            # forward+backward are fused in this fn; the split is the
+            # imperative API's, the timing is the fused step's
+            self.timers(FORWARD_MICRO_TIMER).stop(sync_with=loss)
         self._stashed = (loss, grads)
         return loss
 
@@ -749,6 +759,9 @@ class Engine:
         engine.py:1201; micro_steps increments here like engine.py:1286, so
         is_gradient_accumulation_boundary() reads True after the last
         microbatch's backward())."""
+        wall = self._config.wall_clock_breakdown
+        if wall:
+            self.timers(STEP_MICRO_TIMER).start()
         gas = self.gradient_accumulation_steps()
         if self._acc_count >= gas:
             if self._offload is not None:
@@ -768,8 +781,18 @@ class Engine:
             self._grad_acc = None
             self._acc_count = 0
             self._after_optimizer_step(metrics)
+            if wall:
+                self.timers(STEP_MICRO_TIMER).stop(
+                    sync_with=metrics.get("grad_norm")
+                )
+                self.timers.log(
+                    [FORWARD_MICRO_TIMER, STEP_MICRO_TIMER],
+                    ranks=[0],
+                )
             if getattr(self, "_profile_args", None) is not None:
                 self._maybe_profile_flops(*self._profile_args)
+        elif wall:
+            self.timers(STEP_MICRO_TIMER).stop()
         self.micro_steps += 1
 
     def _after_optimizer_step(self, metrics):
@@ -820,6 +843,9 @@ class Engine:
         batch = self._pack_pld(batch)
         rng, self.rng = _split(self.rng)
         lr = jnp.float32(self._current_lr())
+        wall = self._config.wall_clock_breakdown
+        if wall:
+            self.timers("train_batch").start()
         self.tput_timer.start()
         if self._layer_collector is not None:
             self._layer_collector.clear()
@@ -845,6 +871,12 @@ class Engine:
         self.micro_steps += self.gradient_accumulation_steps()
         self._after_optimizer_step(metrics)
         self.tput_timer.stop(global_step=True, sync_with=metrics["loss"])
+        if wall:
+            self.timers("train_batch").stop(sync_with=metrics["loss"])
+            spp = max(self._config.steps_per_print, 1)
+            if self.global_steps % spp == 0:
+                # the timer accumulated spp steps since the last log
+                self.timers.log(["train_batch"], normalizer=spp, ranks=[0])
         self._maybe_profile_flops(batch, rng)
         return metrics["loss"]
 
@@ -1126,17 +1158,32 @@ class Engine:
                 # Unreadable manifest (None) falls back to attempting the
                 # legacy shape.
                 target["master"] = state.master
+            restored = None
             try:
                 restored = load_sharded_tree(optim_dir, target)
+            except Exception as first_err:
+                if "master" in target:
+                    # the legacy-layout guess was wrong (checkpoint has no
+                    # master tree): retry plain before giving anything up
+                    target.pop("master")
+                    try:
+                        restored = load_sharded_tree(optim_dir, target)
+                    except Exception as e:
+                        logger.warning(
+                            "sharded optimizer restore failed (%s); "
+                            "params-only load — likely a zero-stage/"
+                            "structure change since save", e
+                        )
+                else:
+                    logger.warning(
+                        "sharded optimizer restore failed (%s); params-only "
+                        "load — likely a zero-stage/structure change since "
+                        "save", first_err
+                    )
+            if restored is not None:
                 master = restored.pop("master", None)
                 if state.master is not None and os.path.isdir(master_dir):
                     master = load_sharded_tree(master_dir, state.master)
-            except Exception as e:
-                logger.warning(
-                    "sharded optimizer restore failed (%s); params-only load "
-                    "— likely a zero-stage/structure change since save", e
-                )
-            else:
                 # scalars replicated over the mesh (the initial state's
                 # scalar leaves may be uncommitted single-device arrays, so
                 # their sharding is not a usable placement target)

@@ -61,6 +61,32 @@ def train_steps(engine, steps=10, seed=0):
     return losses
 
 
+def test_wall_clock_breakdown_timers():
+    def loss_fn(p, b):
+        x, y = b
+        return jnp.mean((x @ p["w"] - y) ** 2)
+
+    engine, _, _, _ = ds.initialize(
+        model=loss_fn, model_parameters={"w": jnp.zeros((4, 1))},
+        config_params={"train_batch_size": 8,
+                       "wall_clock_breakdown": True,
+                       "steps_per_print": 2,
+                       "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}},
+    )
+    x = np.random.RandomState(0).randn(8, 4).astype(np.float32)
+    y = np.random.RandomState(1).randn(8, 1).astype(np.float32)
+    batch = (jnp.asarray(x), jnp.asarray(y))
+    for _ in range(2):
+        engine.train_batch(batch=batch)
+    assert "train_batch" in engine.timers.timers
+    # imperative path populates the micro timers too
+    loss = engine(batch)
+    engine.backward(loss)
+    engine.step()
+    assert "forward_microstep" in engine.timers.timers
+    assert "step_microstep" in engine.timers.timers
+
+
 def test_train_loss_decreases():
     engine = make_engine()
     losses = train_steps(engine, steps=20, seed=42)
