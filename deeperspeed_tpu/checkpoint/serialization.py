@@ -16,6 +16,8 @@ import jax
 import numpy as np
 from flax import serialization
 
+from ..utils.logging import logger
+
 LATEST_FILE = "latest"
 
 
@@ -146,10 +148,18 @@ def consolidate_fp32_state(checkpoint_dir: str) -> Dict:
                     optim_keys is None or "master" in optim_keys):
                 try:
                     optim = ckptr.restore(os.path.abspath(optim_dir))
-                except Exception:
-                    optim = None  # partial/corrupt optim dir: params below
+                except Exception as e:
+                    logger.warning(
+                        "could not read optim tree %s (%s); consolidation "
+                        "falls back to the params tree", optim_dir, e,
+                    )
+                    optim = None
                 if isinstance(optim, dict) and optim.get("master") is not None:
                     return optim["master"]
+            logger.warning(
+                "no fp32 master found in %s; returning the (compute-dtype) "
+                "params tree instead", sharded,
+            )
             return ckptr.restore(os.path.abspath(os.path.join(sharded, "params")))
     for fname in sorted(os.listdir(checkpoint_dir)):
         if fname.startswith("zero_pp_rank_") and fname.endswith(".msgpack"):

@@ -731,7 +731,7 @@ class Engine:
             self._profile_args = (batch, rng)
         wall = self._config.wall_clock_breakdown
         if wall:
-            self.timers(FORWARD_MICRO_TIMER).start()
+            self._timer_start(FORWARD_MICRO_TIMER)
         loss, grads = self._forward_grad_fn()(self.state, batch, rng)
         if wall:
             # forward+backward are fused in this fn; the split is the
@@ -761,7 +761,7 @@ class Engine:
         microbatch's backward())."""
         wall = self._config.wall_clock_breakdown
         if wall:
-            self.timers(STEP_MICRO_TIMER).start()
+            self._timer_start(STEP_MICRO_TIMER)
         gas = self.gradient_accumulation_steps()
         if self._acc_count >= gas:
             if self._offload is not None:
@@ -845,7 +845,7 @@ class Engine:
         lr = jnp.float32(self._current_lr())
         wall = self._config.wall_clock_breakdown
         if wall:
-            self.timers("train_batch").start()
+            self._timer_start("train_batch")
         self.tput_timer.start()
         if self._layer_collector is not None:
             self._layer_collector.clear()
@@ -873,12 +873,24 @@ class Engine:
         self.tput_timer.stop(global_step=True, sync_with=metrics["loss"])
         if wall:
             self.timers("train_batch").stop(sync_with=metrics["loss"])
+            self._wall_steps = getattr(self, "_wall_steps", 0) + 1
             spp = max(self._config.steps_per_print, 1)
             if self.global_steps % spp == 0:
-                # the timer accumulated spp steps since the last log
-                self.timers.log(["train_batch"], normalizer=spp, ranks=[0])
+                # normalize by the steps ACTUALLY accumulated (resume or
+                # mixed imperative/fused use lands off the spp boundary)
+                self.timers.log(["train_batch"],
+                                normalizer=self._wall_steps, ranks=[0])
+                self._wall_steps = 0
         self._maybe_profile_flops(batch, rng)
         return metrics["loss"]
+
+    def _timer_start(self, name):
+        """Start a phase timer, recovering from a previous run that died
+        between start and stop (a crashed step must not poison the timer)."""
+        t = self.timers(name)
+        if t.started_:
+            t.reset()
+        t.start()
 
     # ------------------------------------------------------------------ #
     # fork extras: layer-output hooks + gradient stashing
@@ -994,6 +1006,28 @@ class Engine:
     # ------------------------------------------------------------------ #
     # checkpointing (reference engine.py:1462-1817)
     # ------------------------------------------------------------------ #
+
+    def _zero3_consolidated_fp16_state_dict(self):
+        """Fully-gathered compute-dtype params as a host pytree (reference
+        engine.py:1820 gathers the ZeRO-3 partitions into one fp16 state
+        dict). Gathers LEAF BY LEAF so peak device memory is one full tensor
+        above the sharded copy (the reference bounds it per-layer the same
+        way) — never the whole replicated model at once."""
+        flat, treedef = jax.tree_util.tree_flatten(self.state.params)
+        rep = NamedSharding(self.mesh, P())
+        out = []
+        for leaf in flat:
+            full = jax.jit(lambda x: x, out_shardings=rep)(leaf)
+            out.append(np.asarray(jax.device_get(full)))
+            del full
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    # reference-compatible public name
+    zero3_consolidated_fp16_state_dict = _zero3_consolidated_fp16_state_dict
+
+    def module_state_dict(self):
+        """Host copy of the (consolidated) model parameters."""
+        return self._zero3_consolidated_fp16_state_dict()
 
     def _fully_replicate(self, tree):
         """All-gather a sharded pytree so each process holds a full copy."""
@@ -1183,7 +1217,14 @@ class Engine:
             if restored is not None:
                 master = restored.pop("master", None)
                 if state.master is not None and os.path.isdir(master_dir):
-                    master = load_sharded_tree(master_dir, state.master)
+                    try:
+                        master = load_sharded_tree(master_dir, state.master)
+                    except Exception as e:
+                        logger.warning(
+                            "sharded master restore failed (%s); master will "
+                            "be re-derived from the restored params", e
+                        )
+                        master = None
                 # scalars replicated over the mesh (the initial state's
                 # scalar leaves may be uncommitted single-device arrays, so
                 # their sharding is not a usable placement target)

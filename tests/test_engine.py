@@ -61,6 +61,24 @@ def train_steps(engine, steps=10, seed=0):
     return losses
 
 
+def test_zero3_consolidated_state_dict():
+    def loss_fn(p, b):
+        x, y = b
+        return jnp.mean((x @ p["w"] - y) ** 2)
+
+    engine, _, _, _ = ds.initialize(
+        model=loss_fn, model_parameters={"w": jnp.ones((8, 2))},
+        config_params={"train_batch_size": 8,
+                       "zero_optimization": {"stage": 3},
+                       "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}},
+    )
+    sd = engine.zero3_consolidated_fp16_state_dict()
+    assert isinstance(sd["w"], np.ndarray)
+    assert sd["w"].shape == (8, 2)  # full, not the 1/8 shard
+    np.testing.assert_allclose(sd["w"], 1.0)
+    assert engine.module_state_dict()["w"].shape == (8, 2)
+
+
 def test_wall_clock_breakdown_timers():
     def loss_fn(p, b):
         x, y = b
