@@ -886,11 +886,9 @@ class Engine:
 
     def _timer_start(self, name):
         """Start a phase timer, recovering from a previous run that died
-        between start and stop (a crashed step must not poison the timer)."""
-        t = self.timers(name)
-        if t.started_:
-            t.reset()
-        t.start()
+        between start and stop (a crashed step must not poison the timer;
+        completed intervals in the window are kept)."""
+        self.timers(name).safe_start()
 
     # ------------------------------------------------------------------ #
     # fork extras: layer-output hooks + gradient stashing
@@ -1017,7 +1015,7 @@ class Engine:
         rep = NamedSharding(self.mesh, P())
         out = []
         for leaf in flat:
-            full = jax.jit(lambda x: x, out_shardings=rep)(leaf)
+            full = jax.device_put(leaf, rep)  # reshard, no trace/compile
             out.append(np.asarray(jax.device_get(full)))
             del full
         return jax.tree_util.tree_unflatten(treedef, out)

@@ -37,7 +37,7 @@ from ...checkpoint.serialization import (
 )
 from ...parallel.topology import DATA_AXIS, MODEL_AXIS, PIPE_AXIS
 from ...utils.logging import log_dist, logger
-from ...utils.timer import ThroughputTimer
+from ...utils.timer import SynchronizedWallClockTimer, ThroughputTimer
 from .. import lr_schedules
 from .. import utils as runtime_utils
 from ..config import TrainingConfig
@@ -164,6 +164,7 @@ class PipelineEngine:
             num_workers=1,
             steps_per_output=config.steps_per_print,
         )
+        self.timers = SynchronizedWallClockTimer()
         log_dist(
             f"pipeline engine: stages={self.num_stages} micro_batches="
             f"{self.micro_batches} dp={self.dp_world_size}",
@@ -531,6 +532,23 @@ class PipelineEngine:
         self._mb_count = [0] * self.num_stages
         streams = [list(s.steps()) for s in schedules]
         total_steps = max(len(st) for st in streams)
+        # %breakdown (fork extra, reference pipe/engine.py:330-342). Under
+        # XLA these are HOST DISPATCH times — device execution overlaps, so
+        # per-phase device time is not observable without serializing; the
+        # ratios still expose schedule imbalance and dispatch hotspots.
+        # Train only, as in the reference — eval/inference dispatch must not
+        # pollute the training breakdown.
+        wall = self._config.wall_clock_breakdown and train
+
+        def timed(name, fn, *a):
+            if not wall:
+                return fn(*a)
+            tm = self.timers(f"pipe_{name}")
+            tm.safe_start()
+            out = fn(*a)
+            tm.stop()
+            return out
+
         for t in range(total_steps):
             step_cmds = [
                 streams[s][t] if t < len(streams[s]) else [] for s in
@@ -540,9 +558,9 @@ class PipelineEngine:
             for s in range(self.num_stages):
                 for cmd in step_cmds[s]:
                     if isinstance(cmd, sched_mod.SendActivation):
-                        self._exec_send_activation(s, cmd.buffer_id)
+                        timed("comms", self._exec_send_activation, s, cmd.buffer_id)
                     elif isinstance(cmd, sched_mod.SendGrad):
-                        self._exec_send_grad(s, cmd.buffer_id)
+                        timed("comms", self._exec_send_grad, s, cmd.buffer_id)
             # Phase 2: everything else, stage order.
             did_global = False
             for s in range(self.num_stages):
@@ -550,24 +568,24 @@ class PipelineEngine:
                     if isinstance(cmd, self._SEND_TYPES):
                         continue
                     if isinstance(cmd, sched_mod.RecvActivation):
-                        self._exec_recv_activation(s, cmd.buffer_id)
+                        timed("comms", self._exec_recv_activation, s, cmd.buffer_id)
                     elif isinstance(cmd, sched_mod.RecvGrad):
-                        self._exec_recv_grad(s, cmd.buffer_id)
+                        timed("comms", self._exec_recv_grad, s, cmd.buffer_id)
                     elif isinstance(cmd, sched_mod.LoadMicroBatch):
                         self._exec_load_micro_batch(s, cmd.buffer_id, train)
                     elif isinstance(cmd, sched_mod.ForwardPass):
-                        self._exec_forward_pass(s, cmd.buffer_id, train)
+                        timed("fwd", self._exec_forward_pass, s, cmd.buffer_id, train)
                     elif isinstance(cmd, sched_mod.BackwardPass):
-                        self._exec_backward_pass(s, cmd.buffer_id)
+                        timed("bwd", self._exec_backward_pass, s, cmd.buffer_id)
                     elif isinstance(cmd, sched_mod.ReduceTiedGrads):
                         if not did_global:
-                            self._exec_reduce_tied_grads()
+                            timed("comms", self._exec_reduce_tied_grads)
                     elif isinstance(cmd, sched_mod.ReduceGrads):
                         if not did_global:
-                            self._exec_reduce_grads()
+                            timed("comms", self._exec_reduce_grads)
                     elif isinstance(cmd, sched_mod.OptimizerStep):
                         if not did_global:
-                            self._exec_optimizer_step()
+                            timed("step", self._exec_optimizer_step)
                             did_global = True
                     else:
                         raise RuntimeError(f"unknown instruction {cmd!r}")
@@ -614,7 +632,23 @@ class PipelineEngine:
                 f"lr={self._current_lr():.3e}",
                 ranks=[0],
             )
+            if self._config.wall_clock_breakdown:
+                self._log_phase_breakdown()
         return loss
+
+    def _log_phase_breakdown(self):
+        """%fwd/%bwd/%comms/%step of host dispatch time (fork extra,
+        reference pipe/engine.py:330-342)."""
+        phases = ["pipe_fwd", "pipe_bwd", "pipe_comms", "pipe_step"]
+        elapsed = {p: self.timers(p).elapsed(reset=True) for p in phases}
+        total = sum(elapsed.values()) or 1.0
+        parts = " | ".join(
+            f"{p.removeprefix('pipe_')}: {1e3 * v:.1f}ms ({100 * v / total:.0f}%)"
+            for p, v in elapsed.items()
+        )
+        msg = f"pipe dispatch breakdown: {parts}"
+        log_dist(msg, ranks=[0])
+        return msg
 
     def eval_batch(self, data_iter):
         """Forward-only pipelined evaluation returning the mean loss

@@ -42,6 +42,13 @@ class SynchronizedWallClockTimer:
             self.start_time = time.time()
             self.started_ = True
 
+        def safe_start(self, sync=False):
+            """start() that recovers from a run which died between start and
+            stop: the dangling interval is discarded, accumulated elapsed
+            time from completed intervals is kept."""
+            self.started_ = False
+            self.start(sync=sync)
+
         def stop(self, sync=False, sync_with=None):
             assert self.started_, f"timer {self.name_} is not started"
             if sync or sync_with is not None:

@@ -362,6 +362,33 @@ def test_pipe_fp16_loss_scaling_trains():
     assert losses[-1] < losses[0]
 
 
+def test_pipe_wall_clock_breakdown():
+    mod = PipelineModule(_mlp_layers(), num_stages=2, loss_fn=_mse,
+                         seed_layers=True)
+    mesh = build_mesh({"pipe": 2, "data": 1}, devices=jax.devices()[:2])
+    engine, _, _, _ = ds.initialize(
+        model=mod, mesh=mesh,
+        config_params={"train_batch_size": 4,
+                       "train_micro_batch_size_per_gpu": 2,
+                       "wall_clock_breakdown": True,
+                       "steps_per_print": 1,
+                       "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}},
+    )
+    x = np.random.RandomState(0).randn(2, 8).astype(np.float32)
+    y = (x @ np.linspace(-1, 1, 8 * 4).reshape(8, 4)).astype(np.float32)
+
+    def batches():
+        while True:
+            yield (jnp.asarray(x), jnp.asarray(y))
+
+    engine.train_batch(batches())
+    assert "pipe_fwd" in engine.timers.timers
+    assert "pipe_comms" in engine.timers.timers
+    engine.train_batch(batches())
+    msg = engine._log_phase_breakdown()
+    assert "fwd" in msg and "comms" in msg and "%" in msg
+
+
 def test_inference_batch():
     d, h, o = 8, 16, 4
     mod = PipelineModule(_mlp_layers(d, h, o), num_stages=2, loss_fn=_mse,
