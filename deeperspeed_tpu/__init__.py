@@ -21,6 +21,9 @@ from .parallel.topology import (
     PipelineParallelGrid,
     build_mesh,
 )
+from .runtime.activation_checkpointing import checkpointing
+from .runtime.pipe.engine import PipelineEngine
+from .pipe import LayerSpec, PipelineModule, TiedLayerSpec
 from .ops.transformer import DeepSpeedTransformerLayer, DeepSpeedTransformerConfig
 from .module_inject import replace_transformer_layer, module_inject
 from .utils import logger, log_dist

@@ -1,0 +1,4 @@
+"""Public pipeline-parallelism namespace (reference deepspeed/pipe/
+__init__.py re-exports the runtime.pipe containers the same way)."""
+
+from ..runtime.pipe.module import LayerSpec, PipelineModule, TiedLayerSpec

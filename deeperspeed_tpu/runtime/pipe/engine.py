@@ -620,12 +620,19 @@ class PipelineEngine:
         if data_iter is None:
             assert self._train_iter is not None, "no data iterator"
             data_iter = self._train_iter
+        if self._config.wall_clock_breakdown:
+            self.timers("pipe_batch").safe_start()
         self.tput_timer.start()
         self._pull_micro_batches(data_iter)
         self._exec_schedule(sched_mod.TrainSchedule, train=True)
         self.micro_steps += self.micro_batches
         loss = self._aggregate_total_loss()
         self.tput_timer.stop(global_step=True, sync_with=None)
+        if self._config.wall_clock_breakdown:
+            # float(loss) below (or here) syncs the step, so the batch timer
+            # covers dispatch + device completion
+            float(loss)
+            self.timers("pipe_batch").stop()
         if self.global_steps % self._config.steps_per_print == 0:
             log_dist(
                 f"step={self.global_steps} loss={float(loss):.4f} "
@@ -637,16 +644,22 @@ class PipelineEngine:
         return loss
 
     def _log_phase_breakdown(self):
-        """%fwd/%bwd/%comms/%step of host dispatch time (fork extra,
-        reference pipe/engine.py:330-342)."""
+        """%fwd/%bwd/%comms/%step of the BATCH time (fork extra, reference
+        pipe/engine.py:330-342 divides each phase by train_batch elapsed),
+        plus an 'other' bucket for untimed work (data loading, loss
+        aggregation, device wait) so hidden hotspots stay visible. Phase
+        times are host dispatch (device execution overlaps under XLA)."""
         phases = ["pipe_fwd", "pipe_bwd", "pipe_comms", "pipe_step"]
         elapsed = {p: self.timers(p).elapsed(reset=True) for p in phases}
-        total = sum(elapsed.values()) or 1.0
+        total = self.timers("pipe_batch").elapsed(reset=True)
+        total = total if total > 0 else (sum(elapsed.values()) or 1.0)
+        other = max(total - sum(elapsed.values()), 0.0)
         parts = " | ".join(
             f"{p.removeprefix('pipe_')}: {1e3 * v:.1f}ms ({100 * v / total:.0f}%)"
             for p, v in elapsed.items()
         )
-        msg = f"pipe dispatch breakdown: {parts}"
+        msg = (f"pipe batch breakdown (of {1e3 * total:.1f}ms): {parts} | "
+               f"other: {1e3 * other:.1f}ms ({100 * other / total:.0f}%)")
         log_dist(msg, ranks=[0])
         return msg
 

@@ -1,0 +1,66 @@
+"""Optimizer micro-benchmark (reference tests/perf/adam_test*.py +
+tests/benchmarks/ analog): throughput of the fused (XLA) Adam update and
+the native C++ host Adam over a flat parameter shard.
+
+Run directly:  python tests/benchmarks/adam_bench.py [numel]
+"""
+
+import sys
+import time
+
+import numpy as np
+
+
+def bench_fused_adam(numel: int, iters: int = 20):
+    import jax
+    import jax.numpy as jnp
+
+    from deeperspeed_tpu.ops import FusedAdam
+
+    opt = FusedAdam(lr=1e-3)
+    params = {"flat": jnp.zeros((numel,), jnp.float32)}
+    grads = {"flat": jnp.ones((numel,), jnp.float32) * 1e-3}
+    state = opt.init(params)
+
+    @jax.jit
+    def step(params, grads, state):
+        return opt.update(grads, state, params)
+
+    params, state = step(params, grads, state)  # compile
+    jax.block_until_ready(params)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, state = step(params, grads, state)
+    jax.block_until_ready(params)
+    dt = (time.perf_counter() - t0) / iters
+    return numel / dt / 1e9  # Gelem/s
+
+
+def bench_cpu_adam(numel: int, iters: int = 10):
+    from deeperspeed_tpu.ops import DeepSpeedCPUAdam
+
+    opt = DeepSpeedCPUAdam(lr=1e-3)
+    master = np.zeros(numel, np.float32)
+    grad = np.full(numel, 1e-3, np.float32)
+    exp_avg = np.zeros(numel, np.float32)
+    exp_avg_sq = np.zeros(numel, np.float32)
+    opt.step_flat(1, master, grad, exp_avg, exp_avg_sq)  # warm
+    t0 = time.perf_counter()
+    for i in range(iters):
+        opt.step_flat(i + 2, master, grad, exp_avg, exp_avg_sq)
+    dt = (time.perf_counter() - t0) / iters
+    return numel / dt / 1e9
+
+
+def main():
+    numel = int(sys.argv[1]) if len(sys.argv) > 1 else 64 * 1024 * 1024
+    print(f"numel={numel:,}")
+    print(f"fused (XLA) adam: {bench_fused_adam(numel):.2f} Gelem/s")
+    try:
+        print(f"cpu (AVX) adam:   {bench_cpu_adam(numel):.2f} Gelem/s")
+    except Exception as e:  # native build unavailable
+        print(f"cpu (AVX) adam:   unavailable ({e})")
+
+
+if __name__ == "__main__":
+    main()
