@@ -51,6 +51,8 @@ __all__ = [
     "SendGrad",
     "RecvGrad",
     "PipelineEngine",
+    "make_spmd_pipeline",
+    "make_spmd_pipeline_train_step",
 ]
 
 
@@ -61,4 +63,8 @@ def __getattr__(name):
         from .engine import PipelineEngine
 
         return PipelineEngine
+    if name in ("make_spmd_pipeline", "make_spmd_pipeline_train_step"):
+        from . import spmd
+
+        return getattr(spmd, name)
     raise AttributeError(name)
