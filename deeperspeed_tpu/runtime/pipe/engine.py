@@ -112,12 +112,20 @@ class PipelineEngine:
             "bfloat16": jnp.bfloat16,
             "fp32": jnp.float32,
         }[config.precision]
-        ls = float(config.loss_scale or 0.0)
-        if config.precision == "fp16" and ls == 0.0:
-            # static stand-in for the reference's dynamic scaler (pipeline +
-            # dynamic scaling lands with the SPMD pipeline path)
-            ls = 65536.0
-        self.loss_scale_value = ls or 1.0
+        # loss scaling, host-driven: the scale enters the jitted stage fns
+        # as a traced scalar (no retrace when it moves) and the optimizer
+        # step adjusts it on overflow/growth windows. Scaler selection is
+        # the shared create_loss_scaler rule (fp16 + loss_scale 0 = dynamic)
+        from ..fp16.loss_scaler import create_loss_scaler
+
+        scaler = create_loss_scaler(
+            config.precision,
+            static_loss_scale=config.loss_scale,
+            dynamic_args=config.dynamic_loss_scale_args,
+        )
+        self._dyn_scaler = scaler if scaler.dynamic else None
+        self._dyn_state = scaler.init()
+        self.loss_scale_value = float(jax.device_get(self._dyn_state.loss_scale))
 
         # ZeRO >1 cannot compose with PP (reference pipe/engine.py:63).
         if config.zero_optimization_stage > 1:
@@ -253,26 +261,25 @@ class PipelineEngine:
             )
 
         if with_loss:
-            # Static loss scaling for fp16 (reference runs the pipeline with
-            # an FP16_Optimizer loss scaler); the scaled gradient flows
-            # upstream through SendGrad and every stage unscales at the
-            # accumulation point in _exec_backward_pass.
-            scale = jnp.float32(self.loss_scale_value)
-
-            def f_loss(p, x, label):
+            # fp16 loss scaling (reference runs the pipeline with an
+            # FP16_Optimizer loss scaler); the scale is a TRACED argument so
+            # the dynamic scaler can move it without retracing. The scaled
+            # gradient flows upstream through SendGrad and every stage
+            # unscales at the accumulation point in _exec_backward_pass.
+            def f_loss(p, x, label, scale):
                 y = fwd_raw(cast_params(p), x)
                 loss = loss_fn(y, label).astype(jnp.float32)
                 return loss * scale, loss
 
             argnums = (0, 1) if wrt_input else (0,)
 
-            def fwd(p, x, label):
-                _, loss = f_loss(p, x, label)
+            def fwd(p, x, label, scale):
+                _, loss = f_loss(p, x, label, scale)
                 return loss
 
-            def bwd(p, x, label):
+            def bwd(p, x, label, scale):
                 grads, loss = jax.grad(f_loss, argnums=argnums, has_aux=True)(
-                    p, x, label
+                    p, x, label, scale
                 )
                 dp = grads[0]
                 dx = grads[1] if wrt_input else None
@@ -342,7 +349,9 @@ class PipelineEngine:
         x = self.buffers[stage_id]["inputs"][buffer_id]
         if with_loss:
             loss = fwd(
-                self.stage_params[stage_id], x, self.buffers[stage_id]["labels"][buffer_id]
+                self.stage_params[stage_id], x,
+                self.buffers[stage_id]["labels"][buffer_id],
+                jnp.float32(self.loss_scale_value),
             )
             self._losses.append(loss)
         else:
@@ -358,7 +367,9 @@ class PipelineEngine:
         x = self.buffers[stage_id]["inputs"][buffer_id]
         if with_loss:
             loss, dp, dx = bwd(
-                self.stage_params[stage_id], x, self.buffers[stage_id]["labels"][buffer_id]
+                self.stage_params[stage_id], x,
+                self.buffers[stage_id]["labels"][buffer_id],
+                jnp.float32(self.loss_scale_value),
             )
         else:
             g = self.buffers[stage_id]["in_grads"][buffer_id]
@@ -453,6 +464,14 @@ class PipelineEngine:
         }
         return {"layers": g["layers"], "tied": tied}
 
+    def _update_loss_scale(self, overflow: bool):
+        if self._dyn_scaler is None:
+            return
+        self._dyn_state = self._dyn_scaler.update(
+            self._dyn_state, jnp.asarray(overflow)
+        )
+        self.loss_scale_value = float(jax.device_get(self._dyn_state.loss_scale))
+
     def _exec_optimizer_step(self):
         clip = float(self._config.gradient_clipping or 0.0)
         if "sqnorm" not in self._jit_cache:
@@ -471,8 +490,13 @@ class PipelineEngine:
             self.skipped_steps += 1
             self.stage_grads = [None] * self.num_stages
             self._last_grad_norm = gnorm
-            log_dist(f"non-finite grad norm {gnorm}; skipping step", ranks=[0])
+            self._update_loss_scale(overflow=True)
+            log_dist(
+                f"non-finite grad norm {gnorm}; skipping step "
+                f"(loss scale -> {self.loss_scale_value})", ranks=[0],
+            )
             return
+        self._update_loss_scale(overflow=False)
         coef = 1.0 if clip <= 0 else min(1.0, clip / (gnorm + 1e-6))
         lr = jnp.float32(self._current_lr())
 
@@ -739,6 +763,8 @@ class PipelineEngine:
             "lr_scheduler": self.lr_scheduler.state_dict() if self.lr_scheduler else {},
             "client_state": client_state or {},
             "opt_states": [to_host(o) for o in self.stage_opt],
+            "skipped_steps": self.skipped_steps,
+            "loss_scaler": to_host(self._dyn_state._asdict()),
         }
         ck.save("pipeline_engine_states.msgpack", meta)
         if save_latest:
@@ -765,6 +791,19 @@ class PipelineEngine:
         self.global_steps = int(meta.get("global_steps", 0))
         self.global_samples = int(meta.get("global_samples", 0))
         self.micro_steps = int(meta.get("micro_steps", 0))
+        self.skipped_steps = int(meta.get("skipped_steps", 0))
+        if meta.get("loss_scaler"):
+            from ..fp16.loss_scaler import LossScaleState
+
+            sc = meta["loss_scaler"]
+            self._dyn_state = LossScaleState(
+                loss_scale=jnp.asarray(sc["loss_scale"], jnp.float32),
+                good_steps=jnp.asarray(sc["good_steps"], jnp.int32),
+                hysteresis=jnp.asarray(sc["hysteresis"], jnp.int32),
+            )
+            self.loss_scale_value = float(
+                jax.device_get(self._dyn_state.loss_scale)
+            )
         if load_optimizer_states and meta.get("opt_states"):
             from flax import serialization
 

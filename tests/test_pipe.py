@@ -362,6 +362,65 @@ def test_pipe_fp16_loss_scaling_trains():
     assert losses[-1] < losses[0]
 
 
+def test_pipe_dynamic_loss_scaling():
+    """fp16 pipeline with loss_scale=0: overflow halves the scale (after
+    hysteresis) and skips the step; healthy steps keep training (reference
+    pipeline + FP16_Optimizer dynamic scaler)."""
+
+    def explode(out, target):
+        return jnp.mean((out - target) ** 2) * 1e30
+
+    mod = PipelineModule(_mlp_layers(), num_stages=2, loss_fn=explode,
+                         seed_layers=True)
+    mesh = build_mesh({"pipe": 2, "data": 1}, devices=jax.devices()[:2])
+    engine, _, _, _ = ds.initialize(
+        model=mod, mesh=mesh,
+        config_params={"train_batch_size": 2,
+                       "train_micro_batch_size_per_gpu": 2,
+                       "fp16": {"enabled": True, "loss_scale": 0,
+                                "initial_scale_power": 32},
+                       "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}},
+    )
+    assert engine._dyn_scaler is not None
+    scale0 = engine.loss_scale_value
+    x = np.random.RandomState(0).randn(2, 8).astype(np.float32)
+    y = (x @ np.linspace(-1, 1, 8 * 4).reshape(8, 4)).astype(np.float32)
+
+    def batches():
+        while True:
+            yield (jnp.asarray(x), jnp.asarray(y))
+
+    before = np.asarray(engine.stage_params[0]["layers"][0]["w"], np.float32)
+    for _ in range(3):  # hysteresis default 2: shrink lands by step 3
+        engine.train_batch(batches())
+    assert engine.skipped_steps >= 2
+    assert engine.loss_scale_value < scale0
+    after = np.asarray(engine.stage_params[0]["layers"][0]["w"], np.float32)
+    np.testing.assert_array_equal(before, after)  # steps skipped
+
+    # scaler state survives checkpoint round trip (no post-resume skip storm)
+    import tempfile
+
+    d = tempfile.mkdtemp()
+    engine.save_checkpoint(d)
+    scale_at_save = engine.loss_scale_value
+
+    mod2 = PipelineModule(_mlp_layers(), num_stages=2, loss_fn=explode,
+                          seed_layers=True)
+    engine2, _, _, _ = ds.initialize(
+        model=mod2, mesh=mesh,
+        config_params={"train_batch_size": 2,
+                       "train_micro_batch_size_per_gpu": 2,
+                       "fp16": {"enabled": True, "loss_scale": 0,
+                                "initial_scale_power": 32},
+                       "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}},
+    )
+    assert engine2.loss_scale_value == scale0  # fresh init
+    engine2.load_checkpoint(d)
+    assert engine2.loss_scale_value == scale_at_save
+    assert engine2.skipped_steps == engine.skipped_steps
+
+
 def test_pipe_wall_clock_breakdown():
     mod = PipelineModule(_mlp_layers(), num_stages=2, loss_fn=_mse,
                          seed_layers=True)
