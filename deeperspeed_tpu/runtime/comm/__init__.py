@@ -6,4 +6,6 @@ from .compressed import (
     reconstruct,
     compressed_all_reduce,
     compressed_all_reduce_tree,
+    onebit_all_reduce,
+    onebit_compress,
 )

@@ -104,3 +104,62 @@ def compressed_all_reduce_tree(tree, axis_name: str = "data",
                 average=average),
         tree,
     )
+
+
+# --------------------------------------------------------------------------
+# 1-bit wire format (reference comm/nccl.py:47 compressed_allreduce packs
+# sign bits with cupy packbits; here signs pack into uint8 on device)
+# --------------------------------------------------------------------------
+
+
+def _pack_signs(x32):
+    """(n,) fp32 -> ((ceil(n/8),) uint8 sign bits, padded length)."""
+    n = x32.shape[0]
+    nb = (n + 7) // 8
+    bits = (jnp.pad(x32, (0, nb * 8 - n)) >= 0).astype(jnp.uint8)
+    weights = (2 ** jnp.arange(8, dtype=jnp.uint8))
+    return jnp.sum(bits.reshape(nb, 8) * weights, axis=1,
+                   dtype=jnp.uint8), n
+
+
+def _unpack_signs(packed, n):
+    """uint8 bit rows -> (n,) +-1.0 fp32."""
+    weights = (2 ** jnp.arange(8, dtype=jnp.uint8))
+    bits = (packed[:, None] & weights[None, :]) > 0
+    return jnp.where(bits.reshape(-1)[:n], 1.0, -1.0).astype(jnp.float32)
+
+
+def onebit_compress(x, error):
+    """Error-compensated 1-bit quantization of a flat fp32 tensor.
+
+    Returns (packed uint8 signs, per-tensor scale, new error feedback).
+    scale = mean(|corrected|) preserves expected magnitude (reference
+    OnebitAdam server scale)."""
+    corrected = x.astype(jnp.float32) + error
+    scale = jnp.mean(jnp.abs(corrected))
+    packed, n = _pack_signs(corrected)
+    quantized = _unpack_signs(packed, n) * scale
+    return packed, scale, corrected - quantized
+
+
+def onebit_all_reduce(x, axis_name: str = "data", error=None):
+    """Average `x` over the mesh axis shipping ~1 bit/element + one scale.
+
+    Traced inside shard_map. Each shard quantizes its contribution with
+    error feedback, all_gathers (packed signs, scale), and rebuilds the
+    mean of the quantized contributions — the single-phase analog of the
+    reference's worker->server->all 1-bit allreduce (comm/nccl.py:47).
+    Returns (average, new_error); thread the error back in next step."""
+    shape = x.shape
+    flat = x.reshape(-1).astype(jnp.float32)
+    if error is None:
+        error = jnp.zeros_like(flat)
+    packed, scale, new_error = onebit_compress(flat, error.reshape(-1))
+    all_packed = jax.lax.all_gather(packed, axis_name)  # (W, nb) u8
+    all_scales = jax.lax.all_gather(scale, axis_name)  # (W,)
+    n = flat.shape[0]
+    vals = jax.vmap(lambda p, s: _unpack_signs(p, n) * s)(
+        all_packed, all_scales
+    )
+    avg = jnp.mean(vals, axis=0)
+    return avg.reshape(shape).astype(x.dtype), new_error.reshape(shape)

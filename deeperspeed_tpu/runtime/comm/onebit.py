@@ -28,10 +28,14 @@ def _compress_with_error_feedback(m, err):
 
     Returns (quantized, new_error). scale = mean(|corrected|) preserves the
     expected magnitude, as in the reference's compensated server averaging.
+    Zeros quantize to +scale — the convention a 1-bit WIRE format forces
+    (comm/compressed.py packs `>= 0` sign bits; a bit cannot carry 0), so
+    the in-step quantization and the wire collective stay bit-identical;
+    the error feedback compensates on the next step either way.
     """
     corrected = m + err
     scale = jnp.mean(jnp.abs(corrected))
-    quant = jnp.sign(corrected) * scale
+    quant = jnp.where(corrected >= 0, scale, -scale)
     return quant, corrected - quant
 
 

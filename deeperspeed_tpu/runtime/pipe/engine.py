@@ -791,8 +791,13 @@ class PipelineEngine:
         self.global_steps = int(meta.get("global_steps", 0))
         self.global_samples = int(meta.get("global_samples", 0))
         self.micro_steps = int(meta.get("micro_steps", 0))
-        self.skipped_steps = int(meta.get("skipped_steps", 0))
-        if meta.get("loss_scaler"):
+        if load_optimizer_states:
+            self.skipped_steps = int(meta.get("skipped_steps", 0))
+        # a static configured scale always wins; only a dynamic scaler
+        # resumes its adapted state, and only with the optimizer states
+        # (mirrors the non-pipe engine's optimizer-states-gated restore)
+        if (load_optimizer_states and self._dyn_scaler is not None
+                and meta.get("loss_scaler")):
             from ..fp16.loss_scaler import LossScaleState
 
             sc = meta["loss_scaler"]
