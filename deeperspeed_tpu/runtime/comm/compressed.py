@@ -137,8 +137,10 @@ def onebit_compress(x, error):
     OnebitAdam server scale)."""
     corrected = x.astype(jnp.float32) + error
     scale = jnp.mean(jnp.abs(corrected))
-    packed, n = _pack_signs(corrected)
-    quantized = _unpack_signs(packed, n) * scale
+    packed, _ = _pack_signs(corrected)
+    # same `>= 0` predicate as the pack — bit-identical to unpacking, but
+    # skips the bit-test matrix on the gradient hot path
+    quantized = jnp.where(corrected >= 0, scale, -scale)
     return packed, scale, corrected - quantized
 
 

@@ -55,8 +55,34 @@ def mpi_discovery():
     )
 
 
+def in_aml() -> bool:
+    """Running inside Azure ML? (reference utils/distributed.py:99)."""
+    return "AZUREML_EXPERIMENT_ID" in os.environ
+
+
+def patch_aml_env():
+    """Map the AzureML/MPI env onto MASTER_ADDR/RANK/WORLD_SIZE (reference
+    utils/distributed.py:110) so the standard discovery below finds them."""
+    env = os.environ
+    if "AZ_BATCH_MASTER_NODE" in env:
+        env["MASTER_ADDR"] = env["AZ_BATCH_MASTER_NODE"].split(":")[0]
+    elif "AZ_BATCHAI_MPI_MASTER_NODE" in env:
+        env["MASTER_ADDR"] = env["AZ_BATCHAI_MPI_MASTER_NODE"]
+    env.setdefault("MASTER_PORT", "29500")
+    if "OMPI_COMM_WORLD_RANK" in env:
+        env.setdefault("RANK", env["OMPI_COMM_WORLD_RANK"])
+        env.setdefault("WORLD_SIZE", env["OMPI_COMM_WORLD_SIZE"])
+    logger.info(
+        "AzureML env: master=%s:%s rank=%s world=%s",
+        env.get("MASTER_ADDR"), env.get("MASTER_PORT"),
+        env.get("RANK"), env.get("WORLD_SIZE"),
+    )
+
+
 def discover():
     env = os.environ
+    if in_aml():
+        patch_aml_env()
     if "DS_COORDINATOR_ADDRESS" in env:
         return dict(
             coordinator_address=env["DS_COORDINATOR_ADDRESS"],

@@ -333,3 +333,34 @@ def test_env_report_runs():
     out = proc.stdout.decode()
     assert "native op report" in out
     assert "jax version" in out
+
+
+def test_aml_env_discovery(monkeypatch):
+    """AzureML env maps onto the standard discovery (reference
+    utils/distributed.py:99-137)."""
+    import os
+
+    from deeperspeed_tpu.utils import distributed as dist_mod
+
+    # patch_aml_env writes MASTER_*/RANK/WORLD_SIZE directly into
+    # os.environ; snapshot and restore so nothing leaks into later tests
+    vars_touched = ("MASTER_ADDR", "MASTER_PORT", "RANK", "WORLD_SIZE",
+                    "DS_COORDINATOR_ADDRESS")
+    saved = {v: os.environ.get(v) for v in vars_touched}
+    for var in vars_touched:
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("AZUREML_EXPERIMENT_ID", "exp123")
+    monkeypatch.setenv("AZ_BATCH_MASTER_NODE", "10.0.0.5:6105")
+    monkeypatch.setenv("OMPI_COMM_WORLD_RANK", "3")
+    monkeypatch.setenv("OMPI_COMM_WORLD_SIZE", "8")
+    try:
+        assert dist_mod.in_aml()
+        found = dist_mod.discover()
+        assert found["coordinator_address"] == "10.0.0.5:29500"
+        assert found["process_id"] == 3 and found["num_processes"] == 8
+    finally:
+        for v, old in saved.items():
+            if old is None:
+                os.environ.pop(v, None)
+            else:
+                os.environ[v] = old
