@@ -56,6 +56,11 @@ class GPTConfig:
     # 'auto' | 'pallas' | 'xla' | 'ring' | 'ulysses' (the last two are the
     # context-parallel paths over the 'seq' mesh axis)
     attn_impl: str = "auto"
+    # cross-entropy sequence chunk: the (B, S, V) logits tensor is never
+    # materialized; the loss scans over S-chunks of this many tokens,
+    # rematerializing each chunk's logits in the backward (softmax - onehot).
+    # 0 disables chunking (single fused logits+lse).
+    ce_chunk: int = 128
 
     def __post_init__(self):
         if self.remat_policy not in ("full", "dots"):
@@ -331,8 +336,8 @@ def make_gpt(cfg: GPTConfig, mesh=None):
         x, _ = decoder_block(cfg, mesh, carry, layer_params, positions, attend)
         return x
 
-    def apply_fn(params, tokens):
-        """tokens (B, S) int32 -> logits (B, S, V)."""
+    def hidden_fn(params, tokens):
+        """tokens (B, S) int32 -> final-layernormed hidden states (B, S, D)."""
         cdt = cfg.dtype
         B, S = tokens.shape
         wte = params["embed"]["wte"].astype(cdt)
@@ -358,14 +363,19 @@ def make_gpt(cfg: GPTConfig, mesh=None):
 
         layer_ids = jnp.arange(cfg.n_layer, dtype=jnp.int32)
         x, _ = jax.lax.scan(scan_body, x, (params["layers"], layer_ids))
-        x = layer_norm(
+        return layer_norm(
             x, params["final_ln"]["scale"], params["final_ln"]["bias"], cfg.layernorm_eps
         )
+
+    def head_weight(params):
+        cdt = cfg.dtype
         if cfg.tie_embeddings:
-            logits = x @ params["embed"]["wte"].astype(cdt).T
-        else:
-            logits = x @ params["lm_head"].astype(cdt)
-        return logits
+            return params["embed"]["wte"].astype(cdt).T
+        return params["lm_head"].astype(cdt)
+
+    def apply_fn(params, tokens):
+        """tokens (B, S) int32 -> logits (B, S, V)."""
+        return hidden_fn(params, tokens) @ head_weight(params)
 
     def loss_fn(params, batch):
         """batch: (inputs, targets) int (B, S) each, or tokens (B, S+1)."""
@@ -373,7 +383,34 @@ def make_gpt(cfg: GPTConfig, mesh=None):
             inputs, targets = batch
         else:
             inputs, targets = batch[:, :-1], batch[:, 1:]
-        logits = apply_fn(params, inputs).astype(jnp.float32)
+        x = hidden_fn(params, inputs)
+        w = head_weight(params)
+        B, S, D = x.shape
+        chunk = cfg.ce_chunk
+        if chunk and S % chunk == 0 and S > chunk:
+            # stream the cross-entropy over sequence chunks: the (B, S, V)
+            # logits are never materialized. Each chunk's logits are
+            # recomputed in the backward (one extra head matmul) in exchange
+            # for GBs of saved HBM — this is what unlocks large micro-batches
+            # (the reference's fp16 fused softmax-xent serves the same role,
+            # csrc/transformer/softmax_kernels.cu)
+            n = S // chunk
+            xs = jnp.moveaxis(x.reshape(B, n, chunk, D), 1, 0)
+            ts = jnp.moveaxis(targets.reshape(B, n, chunk), 1, 0)
+
+            @jax.checkpoint
+            def chunk_nll(xc, tc):
+                logits = (xc @ w).astype(jnp.float32)  # (B, chunk, V)
+                lse = jax.scipy.special.logsumexp(logits, axis=-1)
+                tgt = jnp.take_along_axis(logits, tc[..., None], axis=-1)[..., 0]
+                return jnp.sum(lse - tgt)
+
+            def body(acc, xt):
+                return acc + chunk_nll(*xt), None
+
+            total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (xs, ts))
+            return total / (B * S)
+        logits = (x @ w).astype(jnp.float32)
         # nll = logsumexp - target_logit, WITHOUT materializing the fp32
         # log-softmax over the full (B, S, V) tensor (pure HBM traffic)
         lse = jax.scipy.special.logsumexp(logits, axis=-1)

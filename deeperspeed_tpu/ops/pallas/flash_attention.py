@@ -6,11 +6,27 @@ kernels): instead of materializing the (B, H, S, S) score tensor in HBM (the
 XLA fallback does, and OOMs long sequences), the kernel streams K/V blocks
 through VMEM with an online softmax, O(S) memory.
 
-Layout: inputs (B, S, H, Dh) -> internally (B*H, S, Dh). fp32 accumulation,
-bf16/fp16/fp32 inputs. Causal masking via block-level loop bounds + in-block
-masks. Backward is the standard flash-2 recomputation split into a dK/dV
-kernel (grid over K blocks) and a dQ kernel (grid over Q blocks), using the
-saved logsumexp.
+Layouts: the kernels run natively on (B, H, S, Dh) — the last two block dims
+(S-block, Dh) satisfy the TPU (8, 128)-tiling rule for any Dh that is a
+multiple of 8. `flash_attention` keeps the framework-wide (B, S, H, Dh)
+convention and transposes at the boundary (XLA usually fuses these copies
+into neighboring elementwise ops); `flash_attention_bhsd` skips them for
+callers that already hold head-major tensors.
+
+Performance notes (MXU):
+  * all dot_generals take the *input* dtype (bf16) and accumulate fp32 via
+    preferred_element_type — upcasting operands to fp32 first would run the
+    matmuls as multi-pass fp32 MXU ops, ~6x slower;
+  * the causal k-loop is split into a full (unmasked) phase and a diagonal
+    (masked) phase so the in-block iota/where mask is only paid on diagonal
+    blocks;
+  * grid dimensions are declared "parallel" so Mosaic can software-pipeline
+    the (batch, head, block) steps;
+  * softmax statistics (m, l), exp, and accumulators stay fp32.
+
+Backward is the standard flash-2 recomputation split into a dK/dV kernel
+(grid over K blocks) and a dQ kernel (grid over Q blocks), using the saved
+logsumexp.
 """
 
 import functools
@@ -28,8 +44,8 @@ except Exception:  # pragma: no cover
     pltpu = None
     _VMEM = None
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
 NEG_INF = -1e30
 
 
@@ -42,6 +58,17 @@ def _vmem_spec(block_shape=None, index_map=None):
     return pl.BlockSpec(block_shape, index_map, **kwargs)
 
 
+def _compiler_params(interpret, n_parallel):
+    """Declare grid dims order-independent so Mosaic pipelines them."""
+    if interpret or pltpu is None:
+        return {}
+    return {
+        "compiler_params": pltpu.CompilerParams(
+            dimension_semantics=("parallel",) * n_parallel
+        )
+    }
+
+
 def is_available(q) -> bool:
     """Cheap static gate used by models' attn_impl='auto'."""
     try:
@@ -52,7 +79,7 @@ def is_available(q) -> bool:
     except Exception:
         return False
     B, S, H, Dh = q.shape
-    return S % DEFAULT_BLOCK_Q == 0 and S >= DEFAULT_BLOCK_Q and Dh % 8 == 0
+    return S % 128 == 0 and S >= 128 and Dh % 8 == 0
 
 
 # ------------------------------------------------------------------ #
@@ -62,53 +89,61 @@ def is_available(q) -> bool:
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, block_k,
                 seq_len, causal):
-    q = q_ref[0].astype(jnp.float32) * sm_scale  # (BQ, D)
+    q = q_ref[0, 0]  # (BQ, D) input dtype — bf16 dots, fp32 accumulation
     bq = q.shape[0]
-    qi = pl.program_id(1)
+    qi = pl.program_id(2)
     q_start = qi * bq
 
     m0 = jnp.full((bq,), NEG_INF, jnp.float32)
     l0 = jnp.zeros((bq,), jnp.float32)
     acc0 = jnp.zeros((bq, q.shape[1]), jnp.float32)
 
+    def make_body(masked):
+        def body(kb, carry):
+            m, l, acc = carry
+            k = k_ref[0, 0, pl.ds(kb * block_k, block_k), :]
+            v = v_ref[0, 0, pl.ds(kb * block_k, block_k), :]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * sm_scale  # (BQ, BK) fp32
+            if masked:
+                rows = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+                cols = kb * block_k + jax.lax.broadcasted_iota(
+                    jnp.int32, s.shape, 1
+                )
+                s = jnp.where(rows >= cols, s, NEG_INF)
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+            p = jnp.exp(s - m_new[:, None])
+            alpha = jnp.exp(m - m_new)
+            l_new = l * alpha + jnp.sum(p, axis=-1)
+            acc_new = acc * alpha[:, None] + jax.lax.dot_general(
+                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            return m_new, l_new, acc_new
+
+        return body
+
     if causal:
-        # ceil: with block_q != block_k the diagonal may sit mid-block; the
-        # in-block mask zeroes any overshoot
-        num_kb = pl.cdiv(q_start + bq, block_k)
+        # blocks strictly below the diagonal need no mask; the (at most
+        # ceil(bq/bk)+1) blocks straddling it do
+        num_full = q_start // block_k
+        num_all = pl.cdiv(q_start + bq, block_k)
+        carry = jax.lax.fori_loop(0, num_full, make_body(False),
+                                  (m0, l0, acc0))
+        m, l, acc = jax.lax.fori_loop(num_full, num_all, make_body(True),
+                                      carry)
     else:
-        num_kb = seq_len // block_k
-
-    def body(kb, carry):
-        m, l, acc = carry
-        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # (BQ, BK)
-        if causal:
-            rows = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            cols = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        p = jnp.exp(s - m_new[:, None])
-        alpha = jnp.exp(m - m_new)
-        l_new = l * alpha + jnp.sum(p, axis=-1)
-        acc_new = acc * alpha[:, None] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        return m_new, l_new, acc_new
-
-    m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
-    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
-    lse_ref[0, 0] = m + jnp.log(l)
+        m, l, acc = jax.lax.fori_loop(0, seq_len // block_k,
+                                      make_body(False), (m0, l0, acc0))
+    o_ref[0, 0] = (acc / l[:, None]).astype(o_ref.dtype)
+    lse_ref[0, 0, 0] = m + jnp.log(l)
 
 
 def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
-    B, S, H, Dh = q.shape
-    qf = q.transpose(0, 2, 1, 3).reshape(B * H, S, Dh)
-    kf = k.transpose(0, 2, 1, 3).reshape(B * H, S, Dh)
-    vf = v.transpose(0, 2, 1, 3).reshape(B * H, S, Dh)
-    grid = (B * H, S // block_q)
+    B, H, S, Dh = q.shape
+    grid = (B, H, S // block_q)
 
     kernel = functools.partial(
         _fwd_kernel, sm_scale=sm_scale, block_k=block_k, seq_len=S, causal=causal
@@ -117,21 +152,22 @@ def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
         kernel,
         grid=grid,
         in_specs=[
-            _vmem_spec((1, block_q, Dh), lambda b, i: (b, i, 0)),
-            _vmem_spec((1, S, Dh), lambda b, i: (b, 0, 0)),
-            _vmem_spec((1, S, Dh), lambda b, i: (b, 0, 0)),
+            _vmem_spec((1, 1, block_q, Dh), lambda b, h, i: (b, h, i, 0)),
+            _vmem_spec((1, 1, S, Dh), lambda b, h, i: (b, h, 0, 0)),
+            _vmem_spec((1, 1, S, Dh), lambda b, h, i: (b, h, 0, 0)),
         ],
         out_specs=[
-            _vmem_spec((1, block_q, Dh), lambda b, i: (b, i, 0)),
-            _vmem_spec((1, 1, block_q), lambda b, i: (b, 0, i)),
+            _vmem_spec((1, 1, block_q, Dh), lambda b, h, i: (b, h, i, 0)),
+            _vmem_spec((1, 1, 1, block_q), lambda b, h, i: (b, h, 0, i)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B * H, S, Dh), q.dtype),
-            jax.ShapeDtypeStruct((B * H, 1, S), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, S, Dh), q.dtype),
+            jax.ShapeDtypeStruct((B, H, 1, S), jnp.float32),
         ],
         interpret=interpret,
-    )(qf, kf, vf)
-    return o, lse, (qf, kf, vf)
+        **_compiler_params(interpret, 3),
+    )(q, k, v)
+    return o, lse
 
 
 # ------------------------------------------------------------------ #
@@ -141,90 +177,123 @@ def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
 
 def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, o_lse_ref, delta_ref,
                      dk_ref, dv_ref, *, sm_scale, block_q, seq_len, causal):
-    k = k_ref[0].astype(jnp.float32)  # (BK, D)
-    v = v_ref[0].astype(jnp.float32)
+    k = k_ref[0, 0]  # (BK, D) input dtype
+    v = v_ref[0, 0]
     bk = k.shape[0]
-    ki = pl.program_id(1)
+    ki = pl.program_id(2)
     k_start = ki * bk
 
     dk0 = jnp.zeros((bk, k.shape[1]), jnp.float32)
     dv0 = jnp.zeros((bk, v.shape[1]), jnp.float32)
     num_qb = seq_len // block_q
-    start_qb = k_start // block_q if causal else 0
 
-    def body(qb, carry):
-        dk, dv = carry
-        q = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-        do = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-        lse = o_lse_ref[0, 0, pl.ds(qb * block_q, block_q)]
-        delta = delta_ref[0, 0, pl.ds(qb * block_q, block_q)]
-        s = sm_scale * jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # (BQ, BK)
-        if causal:
-            rows = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            cols = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])  # (BQ, BK)
-        dv_new = dv + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        ds = p * (dp - delta[:, None]) * sm_scale
-        dk_new = dk + jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        return dk_new, dv_new
+    def make_body(masked):
+        def body(qb, carry):
+            dk, dv = carry
+            q = q_ref[0, 0, pl.ds(qb * block_q, block_q), :]
+            do = do_ref[0, 0, pl.ds(qb * block_q, block_q), :]
+            lse = o_lse_ref[0, 0, 0, pl.ds(qb * block_q, block_q)]
+            delta = delta_ref[0, 0, 0, pl.ds(qb * block_q, block_q)]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * sm_scale  # (BQ, BK)
+            if masked:
+                rows = qb * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, s.shape, 0
+                )
+                cols = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+                s = jnp.where(rows >= cols, s, NEG_INF)
+            p = jnp.exp(s - lse[:, None])  # (BQ, BK) fp32
+            pc = p.astype(do.dtype)
+            dv_new = dv + jax.lax.dot_general(
+                pc, do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            dp = jax.lax.dot_general(
+                do, v, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            ds = p * (dp - delta[:, None]) * sm_scale
+            dk_new = dk + jax.lax.dot_general(
+                ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            return dk_new, dv_new
 
-    dk, dv = jax.lax.fori_loop(start_qb, num_qb, body, (dk0, dv0))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+        return body
+
+    if causal:
+        # q blocks strictly past this k block are unmasked; the straddling
+        # blocks need the in-block mask
+        start_qb = k_start // block_q
+        full_from = pl.cdiv(k_start + bk, block_q)
+        carry = jax.lax.fori_loop(start_qb, jnp.minimum(full_from, num_qb),
+                                  make_body(True), (dk0, dv0))
+        dk, dv = jax.lax.fori_loop(full_from, num_qb, make_body(False), carry)
+    else:
+        dk, dv = jax.lax.fori_loop(0, num_qb, make_body(False), (dk0, dv0))
+    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_lse_ref, delta_ref, dq_ref,
                    *, sm_scale, block_k, seq_len, causal):
-    q = q_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
-    lse = o_lse_ref[0, 0]
-    delta = delta_ref[0, 0]
+    q = q_ref[0, 0]  # input dtype
+    do = do_ref[0, 0]
+    lse = o_lse_ref[0, 0, 0]
+    delta = delta_ref[0, 0, 0]
     bq = q.shape[0]
-    qi = pl.program_id(1)
+    qi = pl.program_id(2)
     q_start = qi * bq
 
     dq0 = jnp.zeros((bq, q.shape[1]), jnp.float32)
-    num_kb = pl.cdiv(q_start + bq, block_k) if causal else seq_len // block_k
 
-    def body(kb, dq):
-        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        s = sm_scale * jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        if causal:
-            rows = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            cols = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        ds = p * (dp - delta[:, None]) * sm_scale
-        return dq + jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )
+    def make_body(masked):
+        def body(kb, dq):
+            k = k_ref[0, 0, pl.ds(kb * block_k, block_k), :]
+            v = v_ref[0, 0, pl.ds(kb * block_k, block_k), :]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * sm_scale
+            if masked:
+                rows = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+                cols = kb * block_k + jax.lax.broadcasted_iota(
+                    jnp.int32, s.shape, 1
+                )
+                s = jnp.where(rows >= cols, s, NEG_INF)
+            p = jnp.exp(s - lse[:, None])
+            dp = jax.lax.dot_general(
+                do, v, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            ds = p * (dp - delta[:, None]) * sm_scale
+            return dq + jax.lax.dot_general(
+                ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
 
-    dq = jax.lax.fori_loop(0, num_kb, body, dq0)
-    dq_ref[0] = dq.astype(dq_ref.dtype)
+        return body
+
+    if causal:
+        num_full = q_start // block_k
+        num_all = pl.cdiv(q_start + bq, block_k)
+        dq = jax.lax.fori_loop(0, num_full, make_body(False), dq0)
+        dq = jax.lax.fori_loop(num_full, num_all, make_body(True), dq)
+    else:
+        dq = jax.lax.fori_loop(0, seq_len // block_k, make_body(False), dq0)
+    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
 
 
 def _flash_bwd(res, g, sm_scale, causal, block_q, block_k, interpret):
-    qf, kf, vf, o, lse = res
-    BH, S, Dh = qf.shape
+    q, k, v, o, lse = res
+    B, H, S, Dh = q.shape
     do = g
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
-    delta = delta.reshape(BH, 1, S)
+    # delta_i = sum_d dO_i * O_i, laid out (B, H, S) like lse
+    delta = jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
+    )[:, :, None, :]  # (B, H, 1, S) like lse
 
     dkdv = functools.partial(
         _bwd_dkdv_kernel, sm_scale=sm_scale, block_q=block_q, seq_len=S,
@@ -232,25 +301,26 @@ def _flash_bwd(res, g, sm_scale, causal, block_q, block_k, interpret):
     )
     dk, dv = pl.pallas_call(
         dkdv,
-        grid=(BH, S // block_k),
+        grid=(B, H, S // block_k),
         in_specs=[
-            _vmem_spec((1, S, Dh), lambda b, i: (b, 0, 0)),  # q
-            _vmem_spec((1, block_k, Dh), lambda b, i: (b, i, 0)),  # k
-            _vmem_spec((1, block_k, Dh), lambda b, i: (b, i, 0)),  # v
-            _vmem_spec((1, S, Dh), lambda b, i: (b, 0, 0)),  # do
-            _vmem_spec((1, 1, S), lambda b, i: (b, 0, 0)),  # lse
-            _vmem_spec((1, 1, S), lambda b, i: (b, 0, 0)),  # delta
+            _vmem_spec((1, 1, S, Dh), lambda b, h, i: (b, h, 0, 0)),  # q
+            _vmem_spec((1, 1, block_k, Dh), lambda b, h, i: (b, h, i, 0)),  # k
+            _vmem_spec((1, 1, block_k, Dh), lambda b, h, i: (b, h, i, 0)),  # v
+            _vmem_spec((1, 1, S, Dh), lambda b, h, i: (b, h, 0, 0)),  # do
+            _vmem_spec((1, 1, 1, S), lambda b, h, i: (b, h, 0, 0)),  # lse
+            _vmem_spec((1, 1, 1, S), lambda b, h, i: (b, h, 0, 0)),  # delta
         ],
         out_specs=[
-            _vmem_spec((1, block_k, Dh), lambda b, i: (b, i, 0)),
-            _vmem_spec((1, block_k, Dh), lambda b, i: (b, i, 0)),
+            _vmem_spec((1, 1, block_k, Dh), lambda b, h, i: (b, h, i, 0)),
+            _vmem_spec((1, 1, block_k, Dh), lambda b, h, i: (b, h, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((BH, S, Dh), qf.dtype),
-            jax.ShapeDtypeStruct((BH, S, Dh), qf.dtype),
+            jax.ShapeDtypeStruct((B, H, S, Dh), q.dtype),
+            jax.ShapeDtypeStruct((B, H, S, Dh), q.dtype),
         ],
         interpret=interpret,
-    )(qf, kf, vf, do, lse, delta)
+        **_compiler_params(interpret, 3),
+    )(q, k, v, do, lse, delta)
 
     dqk = functools.partial(
         _bwd_dq_kernel, sm_scale=sm_scale, block_k=block_k, seq_len=S,
@@ -258,19 +328,20 @@ def _flash_bwd(res, g, sm_scale, causal, block_q, block_k, interpret):
     )
     dq = pl.pallas_call(
         dqk,
-        grid=(BH, S // block_q),
+        grid=(B, H, S // block_q),
         in_specs=[
-            _vmem_spec((1, block_q, Dh), lambda b, i: (b, i, 0)),  # q
-            _vmem_spec((1, S, Dh), lambda b, i: (b, 0, 0)),  # k
-            _vmem_spec((1, S, Dh), lambda b, i: (b, 0, 0)),  # v
-            _vmem_spec((1, block_q, Dh), lambda b, i: (b, i, 0)),  # do
-            _vmem_spec((1, 1, block_q), lambda b, i: (b, 0, i)),  # lse
-            _vmem_spec((1, 1, block_q), lambda b, i: (b, 0, i)),  # delta
+            _vmem_spec((1, 1, block_q, Dh), lambda b, h, i: (b, h, i, 0)),  # q
+            _vmem_spec((1, 1, S, Dh), lambda b, h, i: (b, h, 0, 0)),  # k
+            _vmem_spec((1, 1, S, Dh), lambda b, h, i: (b, h, 0, 0)),  # v
+            _vmem_spec((1, 1, block_q, Dh), lambda b, h, i: (b, h, i, 0)),  # do
+            _vmem_spec((1, 1, 1, block_q), lambda b, h, i: (b, h, 0, i)),  # lse
+            _vmem_spec((1, 1, 1, block_q), lambda b, h, i: (b, h, 0, i)),  # delta
         ],
-        out_specs=_vmem_spec((1, block_q, Dh), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((BH, S, Dh), qf.dtype),
+        out_specs=_vmem_spec((1, 1, block_q, Dh), lambda b, h, i: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, H, S, Dh), q.dtype),
         interpret=interpret,
-    )(qf, kf, vf, do, lse, delta)
+        **_compiler_params(interpret, 3),
+    )(q, k, v, do, lse, delta)
     return dq, dk, dv
 
 
@@ -283,31 +354,54 @@ def _flash_bwd(res, g, sm_scale, causal, block_q, block_k, interpret):
     jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
 )
 def _flash(q, k, v, sm_scale, causal, block_q, block_k, interpret):
-    o, _, _ = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret)
-    B, S, H, Dh = q.shape
-    return o.reshape(B, H, S, Dh).transpose(0, 2, 1, 3)
+    o, _ = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+    return o
 
 
 def _flash_vjp_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
-    o, lse, (qf, kf, vf) = _flash_fwd(
-        q, k, v, sm_scale, causal, block_q, block_k, interpret
-    )
-    B, S, H, Dh = q.shape
-    out = o.reshape(B, H, S, Dh).transpose(0, 2, 1, 3)
-    return out, (qf, kf, vf, o, lse, (B, S, H, Dh))
+    o, lse = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+    return o, (q, k, v, o, lse)
 
 
 def _flash_vjp_bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
-    qf, kf, vf, o, lse, (B, S, H, Dh) = res
-    gf = g.transpose(0, 2, 1, 3).reshape(B * H, S, Dh)
-    dq, dk, dv = _flash_bwd(
-        (qf, kf, vf, o, lse), gf, sm_scale, causal, block_q, block_k, interpret
-    )
-    unflat = lambda x: x.reshape(B, H, S, Dh).transpose(0, 2, 1, 3)
-    return unflat(dq), unflat(dk), unflat(dv)
+    return _flash_bwd(res, g, sm_scale, causal, block_q, block_k, interpret)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def _resolve_blocks(S, block_q, block_k):
+    if block_q is None:
+        block_q = DEFAULT_BLOCK_Q
+    if block_k is None:
+        block_k = DEFAULT_BLOCK_K
+    block_q = min(block_q, S)
+    block_k = min(block_k, S)
+    assert S % block_q == 0 and S % block_k == 0, (
+        f"seq len {S} must be divisible by block sizes ({block_q}, {block_k})"
+    )
+    return block_q, block_k
+
+
+def flash_attention_bhsd(
+    q,
+    k,
+    v,
+    causal: bool = True,
+    sm_scale: float = None,
+    block_q: int = None,
+    block_k: int = None,
+    interpret: bool = False,
+):
+    """Head-major entry point: q, k, v (B, H, S, Dh) -> (B, H, S, Dh).
+
+    This is the layout the kernels run in; callers that already hold
+    head-major tensors avoid the boundary transposes."""
+    B, H, S, Dh = q.shape
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(Dh)
+    block_q, block_k = _resolve_blocks(S, block_q, block_k)
+    return _flash(q, k, v, sm_scale, causal, block_q, block_k, interpret)
 
 
 def flash_attention(
@@ -316,17 +410,15 @@ def flash_attention(
     v,
     causal: bool = True,
     sm_scale: float = None,
-    block_q: int = DEFAULT_BLOCK_Q,
-    block_k: int = DEFAULT_BLOCK_K,
+    block_q: int = None,
+    block_k: int = None,
     interpret: bool = False,
 ):
     """q, k, v: (B, S, H, Dh) -> (B, S, H, Dh)."""
     B, S, H, Dh = q.shape
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(Dh)
-    block_q = min(block_q, S)
-    block_k = min(block_k, S)
-    assert S % block_q == 0 and S % block_k == 0, (
-        f"seq len {S} must be divisible by block sizes ({block_q}, {block_k})"
-    )
-    return _flash(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+    block_q, block_k = _resolve_blocks(S, block_q, block_k)
+    t = lambda x: x.transpose(0, 2, 1, 3)
+    o = _flash(t(q), t(k), t(v), sm_scale, causal, block_q, block_k, interpret)
+    return o.transpose(0, 2, 1, 3)
