@@ -66,8 +66,19 @@ def _cached_block(cfg: GPTConfig, x, layer_params, k_cache, v_cache,
         ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v_c)
         return ctx, (k_c, v_c)
 
-    x, (k_cache, v_cache) = decoder_block(cfg, None, x, layer_params,
-                                          positions, attend)
+    moe_cfg = cfg.moe
+    if moe_cfg is not None:
+        from .moe import moe_ffn
+
+        def mlp_fn(mlp_in):
+            return moe_ffn(layer_params["moe"], mlp_in, moe_cfg)
+
+        x, ((k_cache, v_cache), _) = decoder_block(
+            cfg, None, x, layer_params, positions, attend, mlp_fn=mlp_fn
+        )
+    else:
+        x, (k_cache, v_cache) = decoder_block(cfg, None, x, layer_params,
+                                              positions, attend)
     return x, k_cache, v_cache
 
 

@@ -61,6 +61,29 @@ class GPTConfig:
     # rematerializing each chunk's logits in the backward (softmax - onehot).
     # 0 disables chunking (single fused logits+lse).
     ce_chunk: int = 128
+    # Mixture-of-Experts: 0 = dense MLP; >0 replaces every layer's FFN with
+    # an expert-parallel MoE (models/moe.py) of this many experts, sharded
+    # over the 'expert' mesh axis. A capability BEYOND the reference, which
+    # predates DeepSpeed-MoE (SURVEY.md §2.3 lists EP as absent).
+    moe_num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_coef: float = 0.01
+    moe_z_coef: float = 1e-3
+
+    @property
+    def moe(self):
+        if not self.moe_num_experts:
+            return None
+        from .moe import MoEConfig
+
+        return MoEConfig(
+            num_experts=self.moe_num_experts,
+            top_k=self.moe_top_k,
+            capacity_factor=self.moe_capacity_factor,
+            aux_loss_coef=self.moe_aux_coef,
+            z_loss_coef=self.moe_z_coef,
+        )
 
     def __post_init__(self):
         if self.remat_policy not in ("full", "dots"):
@@ -120,6 +143,18 @@ def init_params(rng, cfg: GPTConfig):
             "bias": jnp.zeros((D,), jnp.float32),
         },
     }
+    if cfg.moe is not None:
+        from .moe import init_moe_params
+
+        moe_keys = jax.random.split(next(k), L)
+        per_layer = [
+            init_moe_params(moe_keys[i], D, F, cfg.moe, out_std=out_std)
+            for i in range(L)
+        ]
+        params["layers"]["moe"] = jax.tree.map(
+            lambda *xs: jnp.stack(xs), *per_layer
+        )
+        del params["layers"]["mlp"]
     if not cfg.rotary:
         params["embed"]["wpe"] = norm(next(k), (cfg.max_seq, D), std)
     if not cfg.tie_embeddings:
@@ -157,6 +192,15 @@ def param_specs(cfg: GPTConfig):
         },
         "final_ln": {"scale": P(None), "bias": P(None)},
     }
+    if cfg.moe is not None:
+        from .moe import moe_param_specs
+
+        # prepend the stacked layer axis to every expert/router spec
+        specs["layers"]["moe"] = jax.tree.map(
+            lambda s: P(None, *s), moe_param_specs(),
+            is_leaf=lambda x: isinstance(x, P),
+        )
+        del specs["layers"]["mlp"]
     if not cfg.rotary:
         specs["embed"]["wpe"] = P(None, None)
     if not cfg.tie_embeddings:
@@ -248,13 +292,16 @@ def _shard_act(x, mesh, spec):
     )
 
 
-def decoder_block(cfg: GPTConfig, mesh, x, layer_params, positions, attend):
+def decoder_block(cfg: GPTConfig, mesh, x, layer_params, positions, attend,
+                  mlp_fn=None):
     """One decoder layer shared by training (make_gpt) and KV-cache decoding
     (models/generation.py): qkv projection, rotary, residual/MLP wiring.
 
     ``attend(q, k, v) -> (ctx, aux)`` supplies the attention core — dense /
-    flash / context-parallel for training, cache-updating for decode. Returns
-    (x_out, aux)."""
+    flash / context-parallel for training, cache-updating for decode.
+    ``mlp_fn(mlp_in) -> (mlp_out, moe_aux_or_None)`` overrides the dense FFN
+    (the MoE hook). Returns (x_out, aux) — with an mlp_fn override, aux is
+    (attend_aux, moe_aux)."""
     cdt = cfg.dtype
     B, S, D = x.shape
     H, Dh = cfg.n_head, cfg.head_dim
@@ -286,14 +333,18 @@ def decoder_block(cfg: GPTConfig, mesh, x, layer_params, positions, attend):
         mlp_in = layer_norm(
             x, layer_params["ln2_scale"], layer_params["ln2_bias"], cfg.layernorm_eps
         )
-    h = mlp_in @ layer_params["mlp"]["wi"].astype(cdt) + layer_params["mlp"][
-        "bi"
-    ].astype(cdt)
-    h = jax.nn.gelu(h, approximate=True)
-    h = _shard_act(h, mesh, P(DATA_AXIS, SEQ_AXIS, MODEL_AXIS))
-    mlp_out = h @ layer_params["mlp"]["wo"].astype(cdt) + layer_params["mlp"][
-        "bo"
-    ].astype(cdt)
+    if mlp_fn is not None:
+        mlp_out, moe_aux = mlp_fn(mlp_in)
+        aux = (aux, moe_aux)
+    else:
+        h = mlp_in @ layer_params["mlp"]["wi"].astype(cdt) + layer_params[
+            "mlp"
+        ]["bi"].astype(cdt)
+        h = jax.nn.gelu(h, approximate=True)
+        h = _shard_act(h, mesh, P(DATA_AXIS, SEQ_AXIS, MODEL_AXIS))
+        mlp_out = h @ layer_params["mlp"]["wo"].astype(cdt) + layer_params[
+            "mlp"
+        ]["bo"].astype(cdt)
 
     if cfg.parallel_residual:
         x = x + attn_out + mlp_out
@@ -332,12 +383,26 @@ def make_gpt(cfg: GPTConfig, mesh=None):
             return cp_attend(q, k, v), None
         return causal_attention(q, k, v, impl=cfg.attn_impl), None
 
+    moe_cfg = cfg.moe
+
     def block(carry, layer_params, positions):
-        x, _ = decoder_block(cfg, mesh, carry, layer_params, positions, attend)
-        return x
+        """-> (x, this layer's scalar moe auxiliary loss; 0 when dense)."""
+        if moe_cfg is None:
+            x, _ = decoder_block(cfg, mesh, carry, layer_params, positions,
+                                 attend)
+            return x, jnp.float32(0.0)
+        from .moe import moe_ffn, moe_loss
+
+        def mlp_fn(mlp_in):
+            return moe_ffn(layer_params["moe"], mlp_in, moe_cfg, mesh=mesh)
+
+        x, (_, moe_aux) = decoder_block(cfg, mesh, carry, layer_params,
+                                        positions, attend, mlp_fn=mlp_fn)
+        return x, moe_loss(moe_aux, moe_cfg)
 
     def hidden_fn(params, tokens):
-        """tokens (B, S) int32 -> final-layernormed hidden states (B, S, D)."""
+        """tokens (B, S) int32 -> (final-layernormed hidden states (B, S, D),
+        summed moe auxiliary loss — 0.0 for dense models)."""
         cdt = cfg.dtype
         B, S = tokens.shape
         wte = params["embed"]["wte"].astype(cdt)
@@ -354,18 +419,22 @@ def make_gpt(cfg: GPTConfig, mesh=None):
             step = jax.checkpoint(step, prevent_cse=False, policy=policy)
 
         def scan_body(carry, xs):
+            x, aux_sum = carry
             layer_params, layer_idx = xs
-            out = step(carry, layer_params)
+            out, layer_aux = step(x, layer_params)
             # cooperative layer-output tap (engine.register_forward_hook);
             # identity unless a collector is active at trace time
             out = hooks.record_layer_output("transformerlayer", out, layer_idx)
-            return out, None
+            return (out, aux_sum + layer_aux), None
 
         layer_ids = jnp.arange(cfg.n_layer, dtype=jnp.int32)
-        x, _ = jax.lax.scan(scan_body, x, (params["layers"], layer_ids))
-        return layer_norm(
+        (x, moe_aux), _ = jax.lax.scan(
+            scan_body, (x, jnp.float32(0.0)), (params["layers"], layer_ids)
+        )
+        x = layer_norm(
             x, params["final_ln"]["scale"], params["final_ln"]["bias"], cfg.layernorm_eps
         )
+        return x, moe_aux
 
     def head_weight(params):
         cdt = cfg.dtype
@@ -375,7 +444,7 @@ def make_gpt(cfg: GPTConfig, mesh=None):
 
     def apply_fn(params, tokens):
         """tokens (B, S) int32 -> logits (B, S, V)."""
-        return hidden_fn(params, tokens) @ head_weight(params)
+        return hidden_fn(params, tokens)[0] @ head_weight(params)
 
     def loss_fn(params, batch):
         """batch: (inputs, targets) int (B, S) each, or tokens (B, S+1)."""
@@ -383,11 +452,15 @@ def make_gpt(cfg: GPTConfig, mesh=None):
             inputs, targets = batch
         else:
             inputs, targets = batch[:, :-1], batch[:, 1:]
-        x = hidden_fn(params, inputs)
+        x, moe_aux = hidden_fn(params, inputs)
         w = head_weight(params)
         B, S, D = x.shape
         chunk = cfg.ce_chunk
-        if chunk and S % chunk == 0 and S > chunk:
+        if chunk and S % chunk:
+            # keep the streaming guarantee for awkward sequence lengths:
+            # largest divisor of S not above the configured chunk
+            chunk = next(c for c in range(min(chunk, S), 0, -1) if S % c == 0)
+        if chunk and S > chunk:
             # stream the cross-entropy over sequence chunks: the (B, S, V)
             # logits are never materialized. Each chunk's logits are
             # recomputed in the backward (one extra head matmul) in exchange
@@ -409,13 +482,13 @@ def make_gpt(cfg: GPTConfig, mesh=None):
                 return acc + chunk_nll(*xt), None
 
             total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (xs, ts))
-            return total / (B * S)
+            return total / (B * S) + moe_aux
         logits = (x @ w).astype(jnp.float32)
         # nll = logsumexp - target_logit, WITHOUT materializing the fp32
         # log-softmax over the full (B, S, V) tensor (pure HBM traffic)
         lse = jax.scipy.special.logsumexp(logits, axis=-1)
         tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-        return jnp.mean(lse - tgt)
+        return jnp.mean(lse - tgt) + moe_aux
 
     def init_fn(rng):
         return init_params(rng, cfg)

@@ -79,7 +79,10 @@ def is_available(q) -> bool:
     except Exception:
         return False
     B, S, H, Dh = q.shape
-    return S % 128 == 0 and S >= 128 and Dh % 8 == 0
+    # _resolve_blocks always finds a valid tiling (the whole-S fallback
+    # needs S % 8 == 0 for the (8,128) sublane rule); gate only on shapes
+    # where the kernel is supported and profitable
+    return S >= 128 and S % 8 == 0 and Dh % 8 == 0
 
 
 # ------------------------------------------------------------------ #
@@ -370,11 +373,23 @@ def _flash_vjp_bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
+def _auto_block(S, default):
+    """Largest power-of-two block <= default that divides S; whole-S block
+    as the fallback (a block equal to the full dim always tiles)."""
+    b = min(default, S)
+    while b >= 128:
+        if S % b == 0:
+            return b
+        b //= 2
+    return S
+
+
 def _resolve_blocks(S, block_q, block_k):
+    """Explicit block sizes must divide S; auto-selected ones always do."""
     if block_q is None:
-        block_q = DEFAULT_BLOCK_Q
+        block_q = _auto_block(S, DEFAULT_BLOCK_Q)
     if block_k is None:
-        block_k = DEFAULT_BLOCK_K
+        block_k = _auto_block(S, DEFAULT_BLOCK_K)
     block_q = min(block_q, S)
     block_k = min(block_k, S)
     assert S % block_q == 0 and S % block_k == 0, (

@@ -110,6 +110,10 @@ class Engine:
         self.mesh = mesh if mesh is not None else _default_mesh()
         self.data_parallel_size = int(self.mesh.shape.get(DATA_AXIS, 1))
         self.rng = rng if rng is not None else jax.random.PRNGKey(0)
+        # per-dispatch rng derivation happens INSIDE the jitted step
+        # (fold_in(base, ticket)); a host-side jax.random.split per call
+        # would cost a full extra device dispatch on the hot path
+        self._rng_tick = 0
 
         self._takes_rng = _loss_fn_takes_rng(model)
         # PLD (reference engine.py:972 passes pld.get_state() kwargs into the
@@ -474,7 +478,7 @@ class Engine:
             return batch
         if theta is None:
             theta = self.progressive_layer_drop.get_theta()
-        return (batch, jnp.float32(theta))
+        return (batch, np.float32(theta))
 
     def _call_loss(self, params, batch, rng, scale):
         kwargs = {}
@@ -498,6 +502,20 @@ class Engine:
         grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
         return loss, grads
 
+    def _rng_args(self):
+        """(base_key, ticket) passed into the jitted step; the key is a jit
+        ARGUMENT (not a closure constant) so reassigning engine.rng between
+        steps takes effect without a retrace."""
+        i = self._rng_tick
+        self._rng_tick += 1
+        return (self.rng, i)
+
+    @staticmethod
+    def _fold_rng(rng):
+        """Traced: derive this dispatch's key from (base_key, ticket)."""
+        key, idx = rng
+        return jax.random.fold_in(key, idx)
+
     def _get_compiled(self, name, builder):
         if name not in self._compiled:
             self._compiled[name] = builder()
@@ -508,6 +526,7 @@ class Engine:
 
         def build():
             def fn(state, batch, rng):
+                rng = self._fold_rng(rng)
                 loss, grads = self._micro_grads(
                     state.params, batch, rng, state.scaler.loss_scale
                 )
@@ -521,6 +540,7 @@ class Engine:
     def _forward_only_fn(self):
         def build():
             def fn(state, batch, rng):
+                rng = self._fold_rng(rng)
                 _, loss = self._call_loss(state.params, batch, rng, jnp.float32(1.0))
                 return loss
 
@@ -581,6 +601,7 @@ class Engine:
             gas = self.gradient_accumulation_steps()
 
             def fn(state, batch, lr, rng):
+                rng = self._fold_rng(rng)
                 loss, grads = self._batch_grads(state, batch, rng, gas)
                 new_state, metrics = self._apply_update_body(state, grads, lr, gas)
                 metrics["loss"] = loss
@@ -599,6 +620,7 @@ class Engine:
             clip = float(self._config.gradient_clipping or 0.0)
 
             def fn(state, batch, rng):
+                rng = self._fold_rng(rng)
                 loss, grads = self._batch_grads(state, batch, rng, gas)
                 grads, gnorm, finite = self._postprocess_grads(
                     state, grads, jnp.float32(gas), clip
@@ -611,16 +633,27 @@ class Engine:
 
     @staticmethod
     def _postprocess_grads(state, grads, gas, clip):
-        """Traced: unscale by loss_scale*gas, global-norm clip, overflow flag."""
+        """Traced: unscale by loss_scale*gas, global-norm clip, overflow flag.
+
+        One reduction pass + one fused multiply pass over the grads (HBM-bound
+        at 125M+ params, so passes matter): the overflow check rides on the
+        squared-norm reduction — any inf/nan grad makes the norm non-finite —
+        and unscale+clip collapse into a single scale factor. A non-finite
+        coef can NaN the scaled grads, but in exactly that case finite=False
+        and the update is discarded wholesale (the `keep` select in
+        _apply_update_body), matching the reference's skip-step
+        (runtime/engine.py:1184-1192 + CheckOverflow, runtime/utils.py)."""
         inv = 1.0 / (state.scaler.loss_scale * gas)
-        grads = jax.tree.map(lambda g: g * inv, grads)
-        flat = jax.tree.leaves(grads)
-        finite = jnp.all(jnp.stack([jnp.all(jnp.isfinite(g)) for g in flat]))
-        gnorm = jnp.sqrt(jnp.sum(jnp.stack([jnp.sum(g**2) for g in flat])))
+        raw_sq = jnp.sum(
+            jnp.stack([jnp.sum(g.astype(jnp.float32) ** 2)
+                       for g in jax.tree.leaves(grads)])
+        )
+        gnorm = jnp.sqrt(raw_sq) * inv  # norm of the UNSCALED grads
+        finite = jnp.isfinite(gnorm)
+        coef = inv
         if clip > 0:
-            coef = jnp.minimum(1.0, clip / (gnorm + 1e-6))
-            grads = jax.tree.map(lambda g: g * coef, grads)
-        grads = jax.tree.map(jnp.nan_to_num, grads)
+            coef = coef * jnp.minimum(1.0, clip / (gnorm + 1e-6))
+        grads = jax.tree.map(lambda g: g * coef, grads)
         return grads, gnorm, finite
 
     def _offload_post_fn(self):
@@ -720,7 +753,7 @@ class Engine:
         """Compute loss on one microbatch. In train mode the backward is fused
         in (grads stashed for `backward()`); in eval mode loss only."""
         batch = self._place_batch(batch)
-        rng, self.rng = _split(self.rng)
+        rng = self._rng_args()
         if self._mode != "train":
             return self._forward_only_fn()(self.state, self._pack_pld(batch, 1.0), rng)
         batch = self._pack_pld(batch)
@@ -766,14 +799,14 @@ class Engine:
         if self._acc_count >= gas:
             if self._offload is not None:
                 grads, gnorm, finite = self._offload_post_fn()(
-                    self.state, self._grad_acc, jnp.float32(self._acc_count)
+                    self.state, self._grad_acc, np.float32(self._acc_count)
                 )
                 metrics = self._offload_apply(grads, gnorm, finite, None)
             else:
-                lr = jnp.float32(self._current_lr())
+                lr = np.float32(self._current_lr())
                 # the imperative path banked unscaled-by-gas grads; scale in fn
                 new_state, metrics = self._apply_update_fn()(
-                    self.state, self._grad_acc, lr, jnp.float32(self._acc_count)
+                    self.state, self._grad_acc, lr, np.float32(self._acc_count)
                 )
                 self.state = new_state
             if self.store_gradients:
@@ -841,8 +874,8 @@ class Engine:
             batch = jax.tree.map(lambda *xs: np.concatenate(xs, axis=0), *parts)
         batch = self._place_batch(batch)
         batch = self._pack_pld(batch)
-        rng, self.rng = _split(self.rng)
-        lr = jnp.float32(self._current_lr())
+        rng = self._rng_args()
+        lr = np.float32(self._current_lr())
         wall = self._config.wall_clock_breakdown
         if wall:
             self._timer_start("train_batch")
@@ -861,7 +894,7 @@ class Engine:
             self._store_grads(grads)
             new_state, metrics = self._apply_update_fn()(
                 self.state, grads, lr,
-                jnp.float32(self.gradient_accumulation_steps()),
+                np.float32(self.gradient_accumulation_steps()),
             )
             metrics = dict(metrics, loss=loss)
             self.state = new_state
@@ -936,6 +969,7 @@ class Engine:
             gas = self.gradient_accumulation_steps()
 
             def fn(state, batch, rng):
+                rng = self._fold_rng(rng)
                 return self._batch_grads(state, batch, rng, gas)
 
             return jax.jit(fn)
@@ -971,6 +1005,8 @@ class Engine:
             return
         self._flops_profiled = True  # one-shot: stop stashing batches
         self._profile_args = None
+        if isinstance(rng, tuple):
+            rng = self._fold_rng(rng)
         from ..profiling.flops_profiler import FlopsProfiler
 
         def fwd(params, batch, rng):
@@ -989,7 +1025,7 @@ class Engine:
 
     def eval_batch(self, batch):
         batch = self._place_batch(batch)
-        rng, self.rng = _split(self.rng)
+        rng = self._rng_args()
         # PLD keeps every layer at eval (theta pinned to 1)
         return self._forward_only_fn()(self.state, self._pack_pld(batch, 1.0), rng)
 
@@ -1376,11 +1412,6 @@ def _default_mesh():
     if n == 1:
         return single_device_mesh((DATA_AXIS,))
     return build_mesh({DATA_AXIS: n})
-
-
-def _split(key):
-    k1, k2 = jax.random.split(key)
-    return k1, k2
 
 
 def _loss_fn_takes_rng(fn) -> bool:
