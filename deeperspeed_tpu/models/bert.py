@@ -50,6 +50,9 @@ class BertConfig:
     attn_impl: str = "auto"
     attn_dropout: float = 0.0
     hidden_dropout: float = 0.0
+    # MLM-loss sequence chunk (streaming CE, no (B,S,V) fp32 logits);
+    # 0 disables chunking
+    ce_chunk: int = 64
 
     @property
     def ffn_dim(self):
@@ -196,18 +199,50 @@ def make_bert(cfg: BertConfig, mesh=None):
         h = _layer_norm(h, m["ln_w"], m["ln_b"], cfg.layernorm_eps)
         return h @ params["embed"]["word"].astype(cdt).T + m["bias"].astype(cdt)
 
+    def _chunk_nll(params, seq_chunk, labels_chunk):
+        """Masked-LM nll over one sequence chunk WITHOUT materializing the
+        fp32 log-softmax (nll = logsumexp - target logit); rematerialized in
+        the backward — the same streaming trick as gpt.py's chunked CE (the
+        reference's fused fp16 softmax-xent kernel served this role,
+        csrc/transformer/softmax_kernels.cu)."""
+        logits = mlm_logits(params, seq_chunk).astype(jnp.float32)
+        valid = labels_chunk != -100
+        safe = jnp.where(valid, labels_chunk, 0)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
+        nll = lse - tgt
+        return jnp.sum(jnp.where(valid, nll, 0.0)), jnp.sum(valid)
+
     def mlm_loss_fn(params, batch, rng=None):
         input_ids, labels = batch[0], batch[1]
         attention_mask = batch[2] if len(batch) > 2 else None
         seq_out, _ = apply_fn(params, input_ids, attention_mask=attention_mask,
                               rng=rng)
-        logits = mlm_logits(params, seq_out).astype(jnp.float32)
-        valid = labels != -100
-        safe_labels = jnp.where(valid, labels, 0)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        nll = -jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
-        denom = jnp.maximum(jnp.sum(valid), 1)
-        return jnp.sum(jnp.where(valid, nll, 0.0)) / denom
+        B, S, D = seq_out.shape
+        chunk = cfg.ce_chunk
+        if chunk and S % chunk:
+            # largest divisor of S <= chunk; below 32 the scan degenerates
+            # (prime S) and the fused path is the lesser evil
+            chunk = next(c for c in range(min(chunk, S), 0, -1) if S % c == 0)
+            if chunk < 32:
+                chunk = 0
+        if chunk and S > chunk:
+            n = S // chunk
+            xs = jnp.moveaxis(seq_out.reshape(B, n, chunk, D), 1, 0)
+            ls = jnp.moveaxis(labels.reshape(B, n, chunk), 1, 0)
+            ck = jax.checkpoint(lambda xc, lc: _chunk_nll(params, xc, lc))
+
+            def body(carry, xt):
+                tot, cnt = carry
+                t, c = ck(*xt)
+                return (tot + t, cnt + c), None
+
+            (total, count), _ = jax.lax.scan(
+                body, (jnp.float32(0.0), jnp.int32(0)), (xs, ls)
+            )
+        else:
+            total, count = _chunk_nll(params, seq_out, labels)
+        return total / jnp.maximum(count, 1)
 
     def init_fn(rng):
         return init_params(rng, cfg)

@@ -28,6 +28,7 @@ from typing import Any, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import PartitionSpec as P
 
 from ..parallel.topology import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
@@ -48,9 +49,13 @@ class GPTConfig:
     layernorm_eps: float = 1e-5
     tie_embeddings: bool = False
     remat: bool = True
-    # remat policy: 'full' recomputes everything (min memory);
-    # 'dots' saves matmul outputs (recomputes only elementwise — much
-    # cheaper backward at a modest memory cost)
+    # remat policy: 'full' recomputes everything (min memory); 'flash'
+    # additionally saves the flash-attention output+logsumexp so the
+    # backward skips re-running the attention forward kernel; 'matmuls'
+    # saves flash o/lse + post-rotary q/k/v + pre-gelu ffn — the backward
+    # recomputes only layernorms/gelu/residuals (near-zero recompute FLOPs
+    # at ~1/2 the no-remat activation memory); 'dots_all' saves every dot
+    # output; 'dots' saves only batch-free dots (weight-stationary)
     remat_policy: str = "full"
     dtype: Any = jnp.bfloat16  # compute dtype for activations
     # 'auto' | 'pallas' | 'xla' | 'ring' | 'ulysses' (the last two are the
@@ -86,10 +91,11 @@ class GPTConfig:
         )
 
     def __post_init__(self):
-        if self.remat_policy not in ("full", "dots"):
+        if self.remat_policy not in ("full", "flash", "matmuls", "dots",
+                                     "dots_all"):
             raise ValueError(
-                f"remat_policy must be 'full' or 'dots', got "
-                f"{self.remat_policy!r}"
+                f"remat_policy must be 'full', 'flash', 'matmuls', 'dots', "
+                f"or 'dots_all', got {self.remat_policy!r}"
             )
 
     @property
@@ -317,6 +323,11 @@ def decoder_block(cfg: GPTConfig, mesh, x, layer_params, positions, attend,
         rd = int(cfg.rotary_pct * Dh) // 2 * 2
         q = rotary_embedding(q, positions, rd)
         k = rotary_embedding(k, positions, rd)
+    # named for selective remat (remat_policy='matmuls'): saving the
+    # post-rotary q/k/v lets the backward skip the qkv projection+rotary
+    q = checkpoint_name(q, "attn_q")
+    k = checkpoint_name(k, "attn_k")
+    v = checkpoint_name(v, "attn_v")
     ctx, aux = attend(q, k, v)
     attn = ctx.reshape(B, S, D)
     attn_out = attn @ layer_params["attn"]["wo"].astype(cdt) + layer_params[
@@ -340,6 +351,9 @@ def decoder_block(cfg: GPTConfig, mesh, x, layer_params, positions, attend,
         h = mlp_in @ layer_params["mlp"]["wi"].astype(cdt) + layer_params[
             "mlp"
         ]["bi"].astype(cdt)
+        # pre-gelu: saving it skips the ffn-in matmul recompute while the
+        # gelu itself stays cheap to replay
+        h = checkpoint_name(h, "mlp_pre")
         h = jax.nn.gelu(h, approximate=True)
         h = _shard_act(h, mesh, P(DATA_AXIS, SEQ_AXIS, MODEL_AXIS))
         mlp_out = h @ layer_params["mlp"]["wo"].astype(cdt) + layer_params[
@@ -414,8 +428,18 @@ def make_gpt(cfg: GPTConfig, mesh=None):
 
         step = partial(block, positions=positions)
         if cfg.remat:
-            policy = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-                      if cfg.remat_policy == "dots" else None)
+            policy = {
+                "full": None,
+                "flash": jax.checkpoint_policies.save_only_these_names(
+                    "flash_o", "flash_lse"
+                ),
+                "matmuls": jax.checkpoint_policies.save_only_these_names(
+                    "flash_o", "flash_lse", "attn_q", "attn_k", "attn_v",
+                    "mlp_pre"
+                ),
+                "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                "dots_all": jax.checkpoint_policies.dots_saveable,
+            }[cfg.remat_policy]
             step = jax.checkpoint(step, prevent_cse=False, policy=policy)
 
         def scan_body(carry, xs):
@@ -458,8 +482,12 @@ def make_gpt(cfg: GPTConfig, mesh=None):
         chunk = cfg.ce_chunk
         if chunk and S % chunk:
             # keep the streaming guarantee for awkward sequence lengths:
-            # largest divisor of S not above the configured chunk
+            # largest divisor of S not above the configured chunk. Below 32
+            # the scan degenerates into tiny matmuls (prime S) — the fused
+            # path is then the lesser evil
             chunk = next(c for c in range(min(chunk, S), 0, -1) if S % c == 0)
+            if chunk < 32:
+                chunk = 0
         if chunk and S > chunk:
             # stream the cross-entropy over sequence chunks: the (B, S, V)
             # logits are never materialized. Each chunk's logits are

@@ -69,6 +69,18 @@ def _compiler_params(interpret, n_parallel):
     }
 
 
+def _auto_block(S, default):
+    """Largest multiple-of-8 block <= default that divides S; whole-S block
+    as the fallback (a block equal to the full dim always tiles, but only
+    fits VMEM for small S — is_available gates the auto path on that)."""
+    b = min(default, S)
+    for d in range(b - b % 8, 127, -8):
+        if S % d == 0:
+            return d
+    return S
+
+
+
 def is_available(q) -> bool:
     """Cheap static gate used by models' attn_impl='auto'."""
     try:
@@ -79,10 +91,14 @@ def is_available(q) -> bool:
     except Exception:
         return False
     B, S, H, Dh = q.shape
-    # _resolve_blocks always finds a valid tiling (the whole-S fallback
-    # needs S % 8 == 0 for the (8,128) sublane rule); gate only on shapes
-    # where the kernel is supported and profitable
-    return S >= 128 and S % 8 == 0 and Dh % 8 == 0
+    if S < 128 or S % 8 or Dh % 8:
+        return False
+    # the auto-picked blocks must also FIT: the (block_q, block_k) fp32
+    # scores tile lives in VMEM, so a whole-S fallback at large awkward S
+    # (no multiple-of-8 divisor in [128, default]) must fall back to XLA
+    bq = _auto_block(S, DEFAULT_BLOCK_Q)
+    bk = _auto_block(S, DEFAULT_BLOCK_K)
+    return bq * bk * 4 <= 8 * 1024 * 1024
 
 
 # ------------------------------------------------------------------ #
@@ -363,6 +379,15 @@ def _flash(q, k, v, sm_scale, causal, block_q, block_k, interpret):
 
 def _flash_vjp_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
     o, lse = _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+    # named so remat policies can pin JUST these residuals (see
+    # jax.checkpoint_policies.save_only_these_names): saving o+lse (~2.1
+    # bytes/activation-element) lets the backward skip re-running the
+    # forward kernel while q/k/v are still rematerialized from the (cheap)
+    # qkv projection — the sweet spot for billion-param single-chip runs
+    from jax.ad_checkpoint import checkpoint_name
+
+    o = checkpoint_name(o, "flash_o")
+    lse = checkpoint_name(lse, "flash_lse")
     return o, (q, k, v, o, lse)
 
 
@@ -371,17 +396,6 @@ def _flash_vjp_bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
-
-
-def _auto_block(S, default):
-    """Largest power-of-two block <= default that divides S; whole-S block
-    as the fallback (a block equal to the full dim always tiles)."""
-    b = min(default, S)
-    while b >= 128:
-        if S % b == 0:
-            return b
-        b //= 2
-    return S
 
 
 def _resolve_blocks(S, block_q, block_k):

@@ -132,6 +132,21 @@ class TrainingConfig:
         else:
             self.precision = c.PRECISION_FP32
         self.bfloat16_enabled = self.precision == c.PRECISION_BF16
+        # masterless bf16 (memory-lean): no fp32 master copy, bf16-stored
+        # optimizer moments, bf16 grads. bf16-only — fp16 needs the master
+        # for loss-scale unscaling precision
+        self.master_weights = bool(
+            bf16_dict.get(c.BFLOAT16_MASTER_WEIGHTS,
+                          fp16_dict.get(c.BFLOAT16_MASTER_WEIGHTS,
+                                        c.BFLOAT16_MASTER_WEIGHTS_DEFAULT))
+        )
+        if not self.master_weights and self.precision == c.PRECISION_FP16:
+            raise ValueError(
+                "master_weights: false is not supported with fp16 — fp16 "
+                "must keep an fp32 master for loss-scale unscaling (use "
+                "bf16 for the masterless memory-lean mode)"
+            )
+        # fp32 never uses a master copy; the flag is simply moot there
 
         self.loss_scale = fp16_dict.get(c.FP16_LOSS_SCALE, c.FP16_LOSS_SCALE_DEFAULT)
         self.initial_scale_power = fp16_dict.get(

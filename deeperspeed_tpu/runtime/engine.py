@@ -154,6 +154,14 @@ class Engine:
                 max(bs_params.get("last_batch_iteration", 0), 0)
             )
         self._compute_dtype = _dtype_of(config.precision)
+        # masterless bf16 (memory-lean mode, config bf16.master_weights=false):
+        # the optimizer updates bf16 params in place with bf16-stored moments
+        # and bf16 grads — 4 bytes/param of optimizer+grad state instead of 16
+        self._use_master = (self._compute_dtype != jnp.float32
+                            and config.master_weights)
+        self._grad_dtype = (jnp.float32 if (self._use_master
+                            or self._compute_dtype == jnp.float32)
+                            else self._compute_dtype)
         self.zero_stage = config.zero_optimization_stage
 
         self.timers = SynchronizedWallClockTimer()
@@ -285,6 +293,9 @@ class Engine:
                 weight_decay=wd,
                 adam_w_mode=bool(adam_w_mode),
                 bias_correction=bias_corr,
+                # bf16 first moment in masterless mode (same condition as
+                # the grad dtype — both fp32 exactly when a master exists)
+                state_dtype=self._grad_dtype,
             )
         if name == CPU_ADAM_OPTIMIZER:
             return DeepSpeedCPUAdam(lr=lr, betas=betas, eps=eps, weight_decay=wd)
@@ -326,7 +337,6 @@ class Engine:
 
     def _init_state(self, params) -> EngineState:
         mesh = self.mesh
-        fp32 = self._compute_dtype == jnp.float32
 
         def place(tree, specs, dtype=None):
             def leaf(x, s):
@@ -363,8 +373,9 @@ class Engine:
                 skipped=jnp.zeros((), jnp.int32),
             )
 
-        master = None if fp32 else place(params, self.master_specs, jnp.float32)
-        opt_src = params_c if fp32 else master
+        master = (place(params, self.master_specs, jnp.float32)
+                  if self._use_master else None)
+        opt_src = master if self._use_master else params_c
         opt_state = jax.jit(
             self.optimizer.init,
             out_shardings=_opt_state_shardings(
@@ -499,7 +510,7 @@ class Engine:
             params, mb, rng, scale
         )
         del scaled
-        grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+        grads = jax.tree.map(lambda g: g.astype(self._grad_dtype), grads)
         return loss, grads
 
     def _rng_args(self):
@@ -574,7 +585,9 @@ class Engine:
             return jnp.reshape(x, (gas, x.shape[0] // gas) + x.shape[1:])
 
         batch_g = jax.tree.map(resh, batch)
-        zero_g = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
+        zero_g = jax.tree.map(
+            lambda p: jnp.zeros(p.shape, self._grad_dtype), state.params
+        )
         zero_g = partition.constrain(zero_g, self.grad_specs, self.mesh)
 
         def body(carry, mb):
@@ -653,7 +666,9 @@ class Engine:
         coef = inv
         if clip > 0:
             coef = coef * jnp.minimum(1.0, clip / (gnorm + 1e-6))
-        grads = jax.tree.map(lambda g: g * coef, grads)
+        grads = jax.tree.map(
+            lambda g: (g.astype(jnp.float32) * coef).astype(g.dtype), grads
+        )
         return grads, gnorm, finite
 
     def _offload_post_fn(self):
@@ -706,19 +721,18 @@ class Engine:
         clip = float(self._config.gradient_clipping or 0.0)
         opt = self.optimizer
         scaler = self._loss_scaler
-        fp32 = self._compute_dtype == jnp.float32
 
         grads, gnorm, finite = self._postprocess_grads(state, grads, gas, clip)
         overflow = ~finite
 
-        target = state.params if fp32 else state.master
+        target = state.master if self._use_master else state.params
         new_target, new_opt = opt.update(grads, state.opt_state, target, lr)
         keep = lambda new, old: jax.tree.map(
             lambda n, o: jnp.where(overflow, o, n), new, old
         )
         new_target = keep(new_target, target)
         new_opt = keep(new_opt, state.opt_state)
-        if fp32:
+        if not self._use_master:
             new_params = partition.constrain(new_target, self.param_specs, self.mesh)
             new_master = None
         else:

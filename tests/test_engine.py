@@ -299,3 +299,83 @@ def test_onebit_adam_compression_phase():
     jax.tree.map(
         lambda a, b: np.testing.assert_array_equal(a, b), v_before, v_after
     )
+
+
+class TestMasterlessBf16:
+    """Memory-lean bf16 mode (bf16.master_weights=false): no fp32 master,
+    bf16-stored optimizer moments, bf16 grads — 4 bytes/param of state, the
+    mode that fits billion-param models on one chip (bench.py's 1.3B run)."""
+
+    CFG = {
+        "train_micro_batch_size_per_gpu": 4,
+        "gradient_accumulation_steps": 2,
+        "bf16": {"enabled": True, "master_weights": False},
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "gradient_clipping": 1.0,
+    }
+
+    @staticmethod
+    def _model():
+        def init(key):
+            k1, k2 = jax.random.split(key)
+            return {"w1": jax.random.normal(k1, (16, 32)) * 0.3,
+                    "w2": jax.random.normal(k2, (32, 1)) * 0.3}
+
+        def loss_fn(params, batch):
+            x, y = batch
+            h = jnp.tanh(x @ params["w1"].astype(jnp.bfloat16))
+            out = h @ params["w2"].astype(jnp.bfloat16)
+            return jnp.mean(
+                (out - y.astype(jnp.bfloat16)).astype(jnp.float32) ** 2
+            )
+
+        return init, loss_fn
+
+    def test_state_dtypes_and_convergence(self):
+        init, loss_fn = self._model()
+        eng, _, _, _ = ds.initialize(
+            model=loss_fn, model_parameters=init(jax.random.PRNGKey(0)),
+            config=dict(self.CFG),
+        )
+        assert eng.state.master is None
+        assert eng.state.params["w1"].dtype == jnp.bfloat16
+        assert eng.state.opt_state.exp_avg["w1"].dtype == jnp.bfloat16
+        rng = np.random.default_rng(0)
+        W = rng.normal(size=(16, 1)).astype(np.float32)
+        losses = []
+        for _ in range(40):
+            X = rng.normal(size=(8, 16)).astype(np.float32)
+            losses.append(float(jax.device_get(eng.train_batch((X, X @ W)))))
+        assert losses[-1] < losses[0] / 3
+
+    def test_checkpoint_round_trip_without_master(self, tmp_path):
+        init, loss_fn = self._model()
+        eng, _, _, _ = ds.initialize(
+            model=loss_fn, model_parameters=init(jax.random.PRNGKey(0)),
+            config=dict(self.CFG),
+        )
+        rng = np.random.default_rng(0)
+        W = rng.normal(size=(16, 1)).astype(np.float32)
+        for _ in range(4):
+            X = rng.normal(size=(8, 16)).astype(np.float32)
+            eng.train_batch((X, X @ W))
+        eng.save_checkpoint(str(tmp_path))
+        eng2, _, _, _ = ds.initialize(
+            model=loss_fn, model_parameters=init(jax.random.PRNGKey(1)),
+            config=dict(self.CFG),
+        )
+        path, _ = eng2.load_checkpoint(str(tmp_path))
+        assert path is not None
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(eng.state.params["w1"])).view(np.uint16),
+            np.asarray(jax.device_get(eng2.state.params["w1"])).view(np.uint16),
+        )
+
+    def test_fp16_masterless_rejected(self):
+        init, loss_fn = self._model()
+        with pytest.raises(ValueError, match="master"):
+            ds.initialize(
+                model=loss_fn, model_parameters=init(jax.random.PRNGKey(0)),
+                config={"train_micro_batch_size_per_gpu": 4,
+                        "fp16": {"enabled": True, "master_weights": False}},
+            )
