@@ -89,6 +89,11 @@ def main():
         metric = "gpt_smoke_tokens_per_sec_per_chip"
         precision = {"enabled": True, "master_weights": True}
 
+    # offline tuning knobs (in-process sweeps are unreliable here: HBM is
+    # not reliably released between engines on the tunneled platform)
+    micro = int(os.environ.get("DS_BENCH_MICRO", micro))
+    gas = int(os.environ.get("DS_BENCH_GAS", gas))
+
     init_fn, _, loss_fn, _ = make_gpt(cfg)
     params = init_fn(jax.random.PRNGKey(0))
     n_params = sum(p.size for p in jax.tree.leaves(params))

@@ -29,7 +29,7 @@ from ..ops.transformer.transformer import (
     to_numpy_f32,
 )
 from ..parallel.topology import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
-from .gpt import _shard_act
+from .gpt import _shard_act, pick_ce_chunk
 from ..utils import hooks
 
 
@@ -219,13 +219,7 @@ def make_bert(cfg: BertConfig, mesh=None):
         seq_out, _ = apply_fn(params, input_ids, attention_mask=attention_mask,
                               rng=rng)
         B, S, D = seq_out.shape
-        chunk = cfg.ce_chunk
-        if chunk and S % chunk:
-            # largest divisor of S <= chunk; below 32 the scan degenerates
-            # (prime S) and the fused path is the lesser evil
-            chunk = next(c for c in range(min(chunk, S), 0, -1) if S % c == 0)
-            if chunk < 32:
-                chunk = 0
+        chunk = pick_ce_chunk(S, cfg.ce_chunk)
         if chunk and S > chunk:
             n = S // chunk
             xs = jnp.moveaxis(seq_out.reshape(B, n, chunk, D), 1, 0)

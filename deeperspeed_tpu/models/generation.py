@@ -57,7 +57,8 @@ def _cached_block(cfg: GPTConfig, x, layer_params, k_cache, v_cache,
         v_c = jax.lax.dynamic_update_slice(
             v_cache, v.astype(cdt), (0, offset, 0, 0)
         )
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_c).astype(jnp.float32)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_c,
+                            preferred_element_type=jnp.float32)
         scores = scores / math.sqrt(Dh)
         key_pos = jnp.arange(k_c.shape[1])
         valid = key_pos[None, :] <= (offset + jnp.arange(S))[:, None]

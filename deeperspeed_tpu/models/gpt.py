@@ -219,6 +219,20 @@ def param_specs(cfg: GPTConfig):
 # ------------------------------------------------------------------ #
 
 
+def pick_ce_chunk(S: int, chunk: int) -> int:
+    """Streaming-CE chunk for sequence length S: the configured chunk when
+    it divides S, else the largest divisor of S not above it. Below 32 the
+    scan would degenerate into tiny matmuls (prime S) — return 0 (fused
+    path) instead. Shared by the GPT and BERT loss functions."""
+    if not chunk or S <= chunk:
+        return 0
+    if S % chunk:
+        chunk = next(c for c in range(min(chunk, S), 0, -1) if S % c == 0)
+        if chunk < 32:
+            return 0
+    return chunk
+
+
 def layer_norm(x, scale, bias, eps):
     x32 = x.astype(jnp.float32)
     mu = jnp.mean(x32, axis=-1, keepdims=True)
@@ -250,7 +264,8 @@ def rotary_embedding(x, positions, rotary_dims):
 def _xla_causal_attention(q, k, v):
     """Reference attention; XLA fuses this well on the MXU. (B,S,H,Dh)."""
     dh = q.shape[-1]
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32)
     scores = scores / math.sqrt(dh)
     s_q, s_k = q.shape[1], k.shape[1]
     mask = jnp.tril(jnp.ones((s_q, s_k), bool))
@@ -479,15 +494,7 @@ def make_gpt(cfg: GPTConfig, mesh=None):
         x, moe_aux = hidden_fn(params, inputs)
         w = head_weight(params)
         B, S, D = x.shape
-        chunk = cfg.ce_chunk
-        if chunk and S % chunk:
-            # keep the streaming guarantee for awkward sequence lengths:
-            # largest divisor of S not above the configured chunk. Below 32
-            # the scan degenerates into tiny matmuls (prime S) — the fused
-            # path is then the lesser evil
-            chunk = next(c for c in range(min(chunk, S), 0, -1) if S % c == 0)
-            if chunk < 32:
-                chunk = 0
+        chunk = pick_ce_chunk(S, cfg.ce_chunk)
         if chunk and S > chunk:
             # stream the cross-entropy over sequence chunks: the (B, S, V)
             # logits are never materialized. Each chunk's logits are

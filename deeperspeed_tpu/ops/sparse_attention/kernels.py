@@ -89,7 +89,7 @@ def layout_density(layout: np.ndarray) -> float:
 
 def _bs_fwd_kernel(cols_ref, cnt_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
                    sm_scale, block, causal, num_heads):
-    q = q_ref[0].astype(jnp.float32) * sm_scale  # (BLK, D)
+    q = q_ref[0]  # (BLK, D) input dtype — bf16 MXU dots, fp32 accumulation
     h = pl.program_id(0) % num_heads
     qi = pl.program_id(1)
     q_start = qi * block
@@ -104,11 +104,11 @@ def _bs_fwd_kernel(cols_ref, cnt_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
         m, l, acc = carry
         kb = cols_ref[h, qi, j]
         valid = j < cnt
-        k = k_ref[0, pl.ds(kb * block, block), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(kb * block, block), :].astype(jnp.float32)
+        k = k_ref[0, pl.ds(kb * block, block), :]
+        v = v_ref[0, pl.ds(kb * block, block), :]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # (BLK, BLK)
+        ) * sm_scale  # (BLK, BLK)
         if causal:
             rows = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             cols = kb * block + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
@@ -124,7 +124,8 @@ def _bs_fwd_kernel(cols_ref, cnt_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
         alpha = jnp.where(m_new <= NEG_INF, 1.0, alpha)
         l_new = l * alpha + jnp.sum(p, axis=-1)
         acc_new = acc * alpha[:, None] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         return m_new, l_new, acc_new
 
@@ -178,8 +179,8 @@ def _bs_fwd(q, k, v, cols, counts, sm_scale, block, causal, interpret):
 def _bs_bwd_dq_kernel(cols_ref, cnt_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                       delta_ref, dq_ref, *, sm_scale, block, causal,
                       num_heads):
-    q = q_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
+    q = q_ref[0]  # input dtype
+    do = do_ref[0]
     lse = lse_ref[0, 0]
     delta = delta_ref[0, 0]
     h = pl.program_id(0) % num_heads
@@ -192,8 +193,8 @@ def _bs_bwd_dq_kernel(cols_ref, cnt_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     def body(j, dq):
         kb = cols_ref[h, qi, j]
         valid = j < cnt
-        k = k_ref[0, pl.ds(kb * block, block), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(kb * block, block), :].astype(jnp.float32)
+        k = k_ref[0, pl.ds(kb * block, block), :]
+        v = v_ref[0, pl.ds(kb * block, block), :]
         s = sm_scale * jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -204,14 +205,19 @@ def _bs_bwd_dq_kernel(cols_ref, cnt_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         s = jnp.where(valid, s, NEG_INF)
         p = jnp.exp(s - lse[:, None])
         # rows with no visible key stored lse=NEG_INF; exp(-1e30 - -1e30)=1
-        # would poison them
-        p = jnp.where((lse <= NEG_INF / 2)[:, None], 0.0, p)
+        # would poison them. Multiplicative fp32 mask, NOT a bool-vector
+        # where: Mosaic cannot lower a lane-vector bool broadcast along a
+        # new sublane dim (compile error on TPU), while fp32 broadcasts
+        # lower fine
+        alive = (lse > NEG_INF / 2).astype(jnp.float32)
+        p = p * alive[:, None]
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         ds = p * (dp - delta[:, None]) * sm_scale
         return dq + jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
 
     dq_ref[0] = jax.lax.fori_loop(0, width, body, dq0).astype(dq_ref.dtype)
@@ -220,8 +226,8 @@ def _bs_bwd_dq_kernel(cols_ref, cnt_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 def _bs_bwd_dkdv_kernel(rows_ref, cnt_ref, q_ref, k_ref, v_ref, do_ref,
                         lse_ref, delta_ref, dk_ref, dv_ref, *, sm_scale,
                         block, causal, num_heads):
-    k = k_ref[0].astype(jnp.float32)
-    v = v_ref[0].astype(jnp.float32)
+    k = k_ref[0]  # input dtype
+    v = v_ref[0]
     h = pl.program_id(0) % num_heads
     ki = pl.program_id(1)
     k_start = ki * block
@@ -234,8 +240,8 @@ def _bs_bwd_dkdv_kernel(rows_ref, cnt_ref, q_ref, k_ref, v_ref, do_ref,
         dk, dv = carry
         qb = rows_ref[h, ki, j]
         valid = j < cnt
-        q = q_ref[0, pl.ds(qb * block, block), :].astype(jnp.float32)
-        do = do_ref[0, pl.ds(qb * block, block), :].astype(jnp.float32)
+        q = q_ref[0, pl.ds(qb * block, block), :]
+        do = do_ref[0, pl.ds(qb * block, block), :]
         lse = lse_ref[0, 0, pl.ds(qb * block, block)]
         delta = delta_ref[0, 0, pl.ds(qb * block, block)]
         s = sm_scale * jax.lax.dot_general(
@@ -247,16 +253,20 @@ def _bs_bwd_dkdv_kernel(rows_ref, cnt_ref, q_ref, k_ref, v_ref, do_ref,
             s = jnp.where(rows >= cols, s, NEG_INF)
         s = jnp.where(valid, s, NEG_INF)
         p = jnp.exp(s - lse[:, None])
-        p = jnp.where((lse <= NEG_INF / 2)[:, None], 0.0, p)
+        # fp32 multiplicative mask, not a bool-vector where (see dq kernel)
+        alive = (lse > NEG_INF / 2).astype(jnp.float32)
+        p = p * alive[:, None]
         dv_new = dv + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         ds = p * (dp - delta[:, None]) * sm_scale
         dk_new = dk + jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         return dk_new, dv_new
 
@@ -339,12 +349,12 @@ def make_block_sparse_attention(layout: np.ndarray, block: int,
     reference's cached triton ops per seq-len)."""
     layout = np.asarray(layout)
     H, nb, _ = layout.shape
-    cols_np, counts_np = build_lut(layout)
-    rows_np, counts_t_np = build_lut(layout.transpose(0, 2, 1))
-    cols = jnp.asarray(cols_np)
-    counts = jnp.asarray(counts_np)
-    rows_t = jnp.asarray(rows_np)
-    counts_t = jnp.asarray(counts_t_np)
+    # LUTs stay NUMPY: converting to jnp here would capture a tracer when
+    # the factory is first invoked inside someone else's jit trace (ops are
+    # cached per seq-len — a cached tracer poisons every later call with
+    # UnexpectedTracerError). numpy constants bind safely into any trace.
+    cols, counts = build_lut(layout)
+    rows_t, counts_t = build_lut(layout.transpose(0, 2, 1))
 
     @jax.custom_vjp
     def attend(q, k, v):

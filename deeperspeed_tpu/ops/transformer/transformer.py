@@ -196,15 +196,20 @@ def _attention_core(q, k, v, config, attention_mask, drop_rng=None):
         return flash_attention(q, k, v, causal=False,
                                interpret=config.interpret)
     dh = q.shape[-1]
-    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) / jnp.sqrt(jnp.float32(dh))
+    # operands stay in the input dtype (bf16 MXU passes); only the
+    # ACCUMULATION is fp32 — upcasting q/k first would run the matmul as a
+    # ~6x-slower multi-pass fp32 MXU op
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) / jnp.sqrt(
+                       jnp.float32(dh))
     if attention_mask is not None:
         # additive mask, broadcastable to (B, nH, Sq, Sk) — HF convention
         s = s + attention_mask.astype(jnp.float32)
     p = jax.nn.softmax(s, axis=-1)
     # dropout on the softmax probabilities, matching reference/HF semantics
     p = _dropout(p, config.attn_dropout_ratio, drop_rng)
-    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                      preferred_element_type=jnp.float32).astype(q.dtype)
 
 
 def _transformer_forward(params, x, config: DeepSpeedTransformerConfig,

@@ -112,6 +112,11 @@ class PipelineEngine:
             "bfloat16": jnp.bfloat16,
             "fp32": jnp.float32,
         }[config.precision]
+        # the pipeline engine always keeps fp32 masters (no masterless mode
+        # here); Engine._configure_basic_optimizer reads these two when it
+        # builds the Adam state dtype
+        self._use_master = self._compute_dtype != jnp.float32
+        self._grad_dtype = jnp.float32
         # loss scaling, host-driven: the scale enters the jitted stage fns
         # as a traced scalar (no retrace when it moves) and the optimizer
         # step adjusts it on overflow/growth windows. Scaler selection is
