@@ -58,13 +58,15 @@ def _vmem_spec(block_shape=None, index_map=None):
     return pl.BlockSpec(block_shape, index_map, **kwargs)
 
 
-def _compiler_params(interpret, n_parallel):
-    """Declare grid dims order-independent so Mosaic pipelines them."""
+def _compiler_params(interpret, n_parallel, semantics=None):
+    """Grid dimension semantics for Mosaic pipelining: "parallel" dims may
+    reorder, "arbitrary" ones run in order (accumulation dims). Default:
+    all-parallel with n_parallel dims; pass an explicit tuple otherwise."""
     if interpret or pltpu is None:
         return {}
     return {
         "compiler_params": pltpu.CompilerParams(
-            dimension_semantics=("parallel",) * n_parallel
+            dimension_semantics=semantics or ("parallel",) * n_parallel
         )
     }
 

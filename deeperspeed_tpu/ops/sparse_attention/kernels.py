@@ -23,7 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
-from ..pallas.flash_attention import _vmem_spec
+from ..pallas.flash_attention import _compiler_params, _vmem_spec
 
 try:  # pltpu also imports on CPU jax builds; interpret mode works anywhere
     from jax.experimental.pallas import tpu as pltpu
@@ -33,11 +33,15 @@ except Exception:  # pragma: no cover
 NEG_INF = -1e30
 
 
-def _lut_pallas_call(kernel, grid, in_specs, out_specs, out_shape, interpret):
+def _lut_pallas_call(kernel, grid, in_specs, out_specs, out_shape,
+                     scratch_shapes, interpret):
     """pallas_call wrapper feeding the two integer LUT arrays (cols/counts)
-    as scalar-prefetch args: whole-array SMEM residents, dynamically
-    indexable, exempt from VMEM (8, 128) tiling constraints. This is the TPU
-    idiom replacing the triton kernels' LUT pointer arguments."""
+    as scalar-prefetch args: whole-array SMEM residents, readable from BOTH
+    the kernel body and the BlockSpec index maps. LUT-driven index maps are
+    what lets K/V blocks STREAM from HBM per grid step (double-buffered by
+    Mosaic) instead of pinning full-sequence tensors in VMEM — the TPU idiom
+    replacing the triton kernels' LUT pointer arguments, with no VMEM cap on
+    sequence length."""
     if pltpu is None:  # pragma: no cover
         raise RuntimeError(
             "Pallas TPU namespace unavailable; use the XLA fallback "
@@ -48,10 +52,22 @@ def _lut_pallas_call(kernel, grid, in_specs, out_specs, out_shape, interpret):
         grid=grid,
         in_specs=in_specs,
         out_specs=out_specs,
+        scratch_shapes=scratch_shapes,
     )
+    # batch/q-block dims reorder freely; the LUT dim accumulates into
+    # scratch and must run in order
+    kwargs = _compiler_params(interpret, 3,
+                              ("parallel", "parallel", "arbitrary"))
     return pl.pallas_call(
-        kernel, grid_spec=grid_spec, out_shape=out_shape, interpret=interpret
+        kernel, grid_spec=grid_spec, out_shape=out_shape, interpret=interpret,
+        **kwargs,
     )
+
+
+def _scratch(shape):
+    if pltpu is None:  # pragma: no cover
+        raise RuntimeError("Pallas TPU namespace unavailable")
+    return pltpu.VMEM(shape, jnp.float32)
 
 
 # ------------------------------------------------------------------ #
@@ -87,54 +103,64 @@ def layout_density(layout: np.ndarray) -> float:
 # ------------------------------------------------------------------ #
 
 
-def _bs_fwd_kernel(cols_ref, cnt_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
-                   sm_scale, block, causal, num_heads):
-    q = q_ref[0]  # (BLK, D) input dtype — bf16 MXU dots, fp32 accumulation
+def _bs_fwd_kernel(cols_ref, cnt_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                   m_scr, l_scr, acc_scr, *, sm_scale, block, causal,
+                   num_heads, width):
+    """One grid step = one (q-block, LUT-entry) pair; the k/v BLOCKS arrive
+    via LUT-driven BlockSpecs (streamed, double-buffered), the online-softmax
+    state lives in VMEM scratch across the LUT dim."""
     h = pl.program_id(0) % num_heads
     qi = pl.program_id(1)
-    q_start = qi * block
+    j = pl.program_id(2)
     cnt = cnt_ref[h, qi]
-    width = cols_ref.shape[-1]
+    kb = cols_ref[h, qi, j]
+    q_start = qi * block
 
-    m0 = jnp.full((block,), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block,), jnp.float32)
-    acc0 = jnp.zeros((block, q.shape[1]), jnp.float32)
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    def body(j, carry):
-        m, l, acc = carry
-        kb = cols_ref[h, qi, j]
-        valid = j < cnt
-        k = k_ref[0, pl.ds(kb * block, block), :]
-        v = v_ref[0, pl.ds(kb * block, block), :]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * sm_scale  # (BLK, BLK)
-        if causal:
-            rows = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            cols = kb * block + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
-        s = jnp.where(valid, s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        # keep m finite for fully-masked rows so exp() stays NaN-free
-        m_safe = jnp.maximum(m_new, NEG_INF / 2)
-        p = jnp.exp(s - m_safe[:, None])
-        p = jnp.where((m_new <= NEG_INF)[:, None], 0.0, p)
-        alpha = jnp.exp(jnp.maximum(m, NEG_INF / 2) - m_safe)
-        alpha = jnp.where(m <= NEG_INF, 0.0, alpha)
-        alpha = jnp.where(m_new <= NEG_INF, 1.0, alpha)
-        l_new = l * alpha + jnp.sum(p, axis=-1)
-        acc_new = acc * alpha[:, None] + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        return m_new, l_new, acc_new
+    q = q_ref[0]  # (BLK, D) input dtype — bf16 MXU dots, fp32 accumulation
+    k = k_ref[0]
+    v = v_ref[0]
+    valid = j < cnt
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * sm_scale  # (BLK, BLK)
+    if causal:
+        rows = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        cols = kb * block + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(rows >= cols, s, NEG_INF)
+    s = jnp.where(valid, s, NEG_INF)
 
-    m, l, acc = jax.lax.fori_loop(0, width, body, (m0, l0, acc0))
-    l_safe = jnp.where(l == 0.0, 1.0, l)
-    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    lse_ref[0, 0] = jnp.where(
-        l == 0.0, NEG_INF, jnp.maximum(m, NEG_INF / 2) + jnp.log(l_safe)
+    m, l, acc = m_scr[...], l_scr[...], acc_scr[...]
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    # keep m finite for fully-masked rows so exp() stays NaN-free
+    m_safe = jnp.maximum(m_new, NEG_INF / 2)
+    p = jnp.exp(s - m_safe[:, None])
+    dead = (m_new <= NEG_INF).astype(jnp.float32)
+    p = p * (1.0 - dead)[:, None]
+    alpha = jnp.exp(jnp.maximum(m, NEG_INF / 2) - m_safe)
+    alpha = alpha * (1.0 - (m <= NEG_INF).astype(jnp.float32))
+    alpha = jnp.where(m_new <= NEG_INF, 1.0, alpha)
+    m_scr[...] = m_new
+    l_scr[...] = l * alpha + jnp.sum(p, axis=-1)
+    acc_scr[...] = acc * alpha[:, None] + jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
     )
+
+    @pl.when(j == width - 1)
+    def _finish():
+        l = l_scr[...]
+        m = m_scr[...]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[...] / l_safe[:, None]).astype(o_ref.dtype)
+        lse_ref[0, 0] = jnp.where(
+            l == 0.0, NEG_INF, jnp.maximum(m, NEG_INF / 2) + jnp.log(l_safe)
+        )
 
 
 def _bs_fwd(q, k, v, cols, counts, sm_scale, block, causal, interpret):
@@ -144,28 +170,35 @@ def _bs_fwd(q, k, v, cols, counts, sm_scale, block, causal, interpret):
     kf = k.transpose(0, 2, 1, 3).reshape(B * H, S, Dh)
     vf = v.transpose(0, 2, 1, 3).reshape(B * H, S, Dh)
     width = cols.shape[-1]
-    grid = (B * H, nb)
+    grid = (B * H, nb, width)
 
     kernel = functools.partial(
         _bs_fwd_kernel, sm_scale=sm_scale, block=block, causal=causal,
-        num_heads=H,
+        num_heads=H, width=width,
     )
     o, lse = _lut_pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            _vmem_spec((1, block, Dh), lambda b, i, cols, cnt: (b, i, 0)),
-            _vmem_spec((1, S, Dh), lambda b, i, cols, cnt: (b, 0, 0)),
-            _vmem_spec((1, S, Dh), lambda b, i, cols, cnt: (b, 0, 0)),
+            _vmem_spec((1, block, Dh), lambda b, i, j, c, n: (b, i, 0)),
+            _vmem_spec((1, block, Dh),
+                       lambda b, i, j, c, n: (b, c[b % H, i, j], 0)),
+            _vmem_spec((1, block, Dh),
+                       lambda b, i, j, c, n: (b, c[b % H, i, j], 0)),
         ],
         out_specs=[
-            _vmem_spec((1, block, Dh), lambda b, i, cols, cnt: (b, i, 0)),
-            _vmem_spec((1, 1, block), lambda b, i, cols, cnt: (b, 0, i)),
+            _vmem_spec((1, block, Dh), lambda b, i, j, c, n: (b, i, 0)),
+            _vmem_spec((1, 1, block), lambda b, i, j, c, n: (b, 0, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B * H, S, Dh), q.dtype),
             jax.ShapeDtypeStruct((B * H, 1, S), jnp.float32),
         ],
+        # 1-D (block,) m/l scratch lowers fine on current Mosaic
+        # (hardware-verified at S=1024..16384); jax's reference kernel pads
+        # to 2-D for older toolchains — revisit if a Mosaic bump rejects it
+        scratch_shapes=[_scratch((block,)), _scratch((block,)),
+                        _scratch((block, Dh))],
         interpret=interpret,
     )(cols, counts, qf, kf, vf)
     return o, lse, (qf, kf, vf)
@@ -177,102 +210,106 @@ def _bs_fwd(q, k, v, cols, counts, sm_scale, block, causal, interpret):
 
 
 def _bs_bwd_dq_kernel(cols_ref, cnt_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
-                      delta_ref, dq_ref, *, sm_scale, block, causal,
-                      num_heads):
+                      delta_ref, dq_ref, dq_scr, *, sm_scale, block, causal,
+                      num_heads, width):
+    h = pl.program_id(0) % num_heads
+    qi = pl.program_id(1)
+    j = pl.program_id(2)
+    cnt = cnt_ref[h, qi]
+    kb = cols_ref[h, qi, j]
+    q_start = qi * block
+
+    @pl.when(j == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
     q = q_ref[0]  # input dtype
     do = do_ref[0]
     lse = lse_ref[0, 0]
     delta = delta_ref[0, 0]
-    h = pl.program_id(0) % num_heads
-    qi = pl.program_id(1)
-    q_start = qi * block
-    cnt = cnt_ref[h, qi]
-    width = cols_ref.shape[-1]
-    dq0 = jnp.zeros((block, q.shape[1]), jnp.float32)
+    k = k_ref[0]
+    v = v_ref[0]
+    valid = j < cnt
+    s = sm_scale * jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    if causal:
+        rows = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        cols = kb * block + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(rows >= cols, s, NEG_INF)
+    s = jnp.where(valid, s, NEG_INF)
+    p = jnp.exp(s - lse[:, None])
+    # rows with no visible key stored lse=NEG_INF; exp(-1e30 - -1e30)=1
+    # would poison them. Multiplicative fp32 mask, NOT a bool-vector where:
+    # Mosaic cannot lower a lane-vector bool broadcast along a new sublane
+    # dim, while fp32 broadcasts lower fine
+    alive = (lse > NEG_INF / 2).astype(jnp.float32)
+    p = p * alive[:, None]
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    ds = p * (dp - delta[:, None]) * sm_scale
+    dq_scr[...] = dq_scr[...] + jax.lax.dot_general(
+        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
 
-    def body(j, dq):
-        kb = cols_ref[h, qi, j]
-        valid = j < cnt
-        k = k_ref[0, pl.ds(kb * block, block), :]
-        v = v_ref[0, pl.ds(kb * block, block), :]
-        s = sm_scale * jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        if causal:
-            rows = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            cols = kb * block + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
-        s = jnp.where(valid, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])
-        # rows with no visible key stored lse=NEG_INF; exp(-1e30 - -1e30)=1
-        # would poison them. Multiplicative fp32 mask, NOT a bool-vector
-        # where: Mosaic cannot lower a lane-vector bool broadcast along a
-        # new sublane dim (compile error on TPU), while fp32 broadcasts
-        # lower fine
-        alive = (lse > NEG_INF / 2).astype(jnp.float32)
-        p = p * alive[:, None]
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        ds = p * (dp - delta[:, None]) * sm_scale
-        return dq + jax.lax.dot_general(
-            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-
-    dq_ref[0] = jax.lax.fori_loop(0, width, body, dq0).astype(dq_ref.dtype)
+    @pl.when(j == width - 1)
+    def _finish():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
 
 
 def _bs_bwd_dkdv_kernel(rows_ref, cnt_ref, q_ref, k_ref, v_ref, do_ref,
-                        lse_ref, delta_ref, dk_ref, dv_ref, *, sm_scale,
-                        block, causal, num_heads):
-    k = k_ref[0]  # input dtype
-    v = v_ref[0]
+                        lse_ref, delta_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
+                        sm_scale, block, causal, num_heads, width):
     h = pl.program_id(0) % num_heads
     ki = pl.program_id(1)
-    k_start = ki * block
+    j = pl.program_id(2)
     cnt = cnt_ref[h, ki]
-    width = rows_ref.shape[-1]
-    dk0 = jnp.zeros((block, k.shape[1]), jnp.float32)
-    dv0 = jnp.zeros((block, v.shape[1]), jnp.float32)
+    qb = rows_ref[h, ki, j]
+    k_start = ki * block
 
-    def body(j, carry):
-        dk, dv = carry
-        qb = rows_ref[h, ki, j]
-        valid = j < cnt
-        q = q_ref[0, pl.ds(qb * block, block), :]
-        do = do_ref[0, pl.ds(qb * block, block), :]
-        lse = lse_ref[0, 0, pl.ds(qb * block, block)]
-        delta = delta_ref[0, 0, pl.ds(qb * block, block)]
-        s = sm_scale * jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # (BQ, BK)
-        if causal:
-            rows = qb * block + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            cols = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
-        s = jnp.where(valid, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])
-        # fp32 multiplicative mask, not a bool-vector where (see dq kernel)
-        alive = (lse > NEG_INF / 2).astype(jnp.float32)
-        p = p * alive[:, None]
-        dv_new = dv + jax.lax.dot_general(
-            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        ds = p * (dp - delta[:, None]) * sm_scale
-        dk_new = dk + jax.lax.dot_general(
-            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        return dk_new, dv_new
+    @pl.when(j == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
 
-    dk, dv = jax.lax.fori_loop(0, width, body, (dk0, dv0))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    k = k_ref[0]  # input dtype
+    v = v_ref[0]
+    q = q_ref[0]
+    do = do_ref[0]
+    lse = lse_ref[0, 0]
+    delta = delta_ref[0, 0]
+    valid = j < cnt
+    s = sm_scale * jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )  # (BQ, BK)
+    if causal:
+        rows = qb * block + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        cols = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(rows >= cols, s, NEG_INF)
+    s = jnp.where(valid, s, NEG_INF)
+    p = jnp.exp(s - lse[:, None])
+    # fp32 multiplicative mask, not a bool-vector where (see dq kernel)
+    alive = (lse > NEG_INF / 2).astype(jnp.float32)
+    p = p * alive[:, None]
+    dv_scr[...] = dv_scr[...] + jax.lax.dot_general(
+        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    ds = p * (dp - delta[:, None]) * sm_scale
+    dk_scr[...] = dk_scr[...] + jax.lax.dot_general(
+        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(j == width - 1)
+    def _finish():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
 def _bs_bwd(res, g, cols, counts, rows_t, counts_t, sm_scale, block, causal,
@@ -290,44 +327,52 @@ def _bs_bwd(res, g, cols, counts, rows_t, counts_t, sm_scale, block, causal,
     dq = _lut_pallas_call(
         functools.partial(
             _bs_bwd_dq_kernel, sm_scale=sm_scale, block=block, causal=causal,
-            num_heads=H,
+            num_heads=H, width=width,
         ),
-        grid=(BH, nb),
+        grid=(BH, nb, width),
         in_specs=[
-            _vmem_spec((1, block, Dh), lambda b, i, *s: (b, i, 0)),  # q
-            _vmem_spec((1, S, Dh), lambda b, i, *s: (b, 0, 0)),  # k
-            _vmem_spec((1, S, Dh), lambda b, i, *s: (b, 0, 0)),  # v
-            _vmem_spec((1, block, Dh), lambda b, i, *s: (b, i, 0)),  # do
-            _vmem_spec((1, 1, block), lambda b, i, *s: (b, 0, i)),  # lse
-            _vmem_spec((1, 1, block), lambda b, i, *s: (b, 0, i)),  # delta
+            _vmem_spec((1, block, Dh), lambda b, i, j, c, n: (b, i, 0)),  # q
+            _vmem_spec((1, block, Dh),
+                       lambda b, i, j, c, n: (b, c[b % H, i, j], 0)),  # k
+            _vmem_spec((1, block, Dh),
+                       lambda b, i, j, c, n: (b, c[b % H, i, j], 0)),  # v
+            _vmem_spec((1, block, Dh), lambda b, i, j, c, n: (b, i, 0)),  # do
+            _vmem_spec((1, 1, block), lambda b, i, j, c, n: (b, 0, i)),  # lse
+            _vmem_spec((1, 1, block), lambda b, i, j, c, n: (b, 0, i)),  # dlt
         ],
-        out_specs=_vmem_spec((1, block, Dh), lambda b, i, *s: (b, i, 0)),
+        out_specs=_vmem_spec((1, block, Dh), lambda b, i, j, c, n: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((BH, S, Dh), qf.dtype),
+        scratch_shapes=[_scratch((block, Dh))],
         interpret=interpret,
     )(cols, counts, qf, kf, vf, do, lse, delta)
 
     dk, dv = _lut_pallas_call(
         functools.partial(
             _bs_bwd_dkdv_kernel, sm_scale=sm_scale, block=block, causal=causal,
-            num_heads=H,
+            num_heads=H, width=width_t,
         ),
-        grid=(BH, nb),
+        grid=(BH, nb, width_t),
         in_specs=[
-            _vmem_spec((1, S, Dh), lambda b, i, *s: (b, 0, 0)),  # q
-            _vmem_spec((1, block, Dh), lambda b, i, *s: (b, i, 0)),  # k
-            _vmem_spec((1, block, Dh), lambda b, i, *s: (b, i, 0)),  # v
-            _vmem_spec((1, S, Dh), lambda b, i, *s: (b, 0, 0)),  # do
-            _vmem_spec((1, 1, S), lambda b, i, *s: (b, 0, 0)),  # lse
-            _vmem_spec((1, 1, S), lambda b, i, *s: (b, 0, 0)),  # delta
+            _vmem_spec((1, block, Dh),
+                       lambda b, i, j, r, n: (b, r[b % H, i, j], 0)),  # q
+            _vmem_spec((1, block, Dh), lambda b, i, j, r, n: (b, i, 0)),  # k
+            _vmem_spec((1, block, Dh), lambda b, i, j, r, n: (b, i, 0)),  # v
+            _vmem_spec((1, block, Dh),
+                       lambda b, i, j, r, n: (b, r[b % H, i, j], 0)),  # do
+            _vmem_spec((1, 1, block),
+                       lambda b, i, j, r, n: (b, 0, r[b % H, i, j])),  # lse
+            _vmem_spec((1, 1, block),
+                       lambda b, i, j, r, n: (b, 0, r[b % H, i, j])),  # dlt
         ],
         out_specs=[
-            _vmem_spec((1, block, Dh), lambda b, i, *s: (b, i, 0)),
-            _vmem_spec((1, block, Dh), lambda b, i, *s: (b, i, 0)),
+            _vmem_spec((1, block, Dh), lambda b, i, j, r, n: (b, i, 0)),
+            _vmem_spec((1, block, Dh), lambda b, i, j, r, n: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((BH, S, Dh), qf.dtype),
             jax.ShapeDtypeStruct((BH, S, Dh), qf.dtype),
         ],
+        scratch_shapes=[_scratch((block, Dh)), _scratch((block, Dh))],
         interpret=interpret,
     )(rows_t, counts_t, qf, kf, vf, do, lse, delta)
     return dq, dk, dv
