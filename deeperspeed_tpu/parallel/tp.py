@@ -24,6 +24,8 @@ Two surfaces:
 
 from typing import Any, Optional
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -83,6 +85,55 @@ def _model_last_spec(ndim: int, last) -> P:
 # Megatron mappings re-expressed as sharding constraints. Under pjit these
 # compile to the same collectives Megatron issues by hand
 # (copy_to / reduce_from / scatter_to / gather_from _model_parallel_region).
+
+
+# --------------------------------------------------------------------- #
+# shard_map-mode megatron f/g operators
+# --------------------------------------------------------------------- #
+#
+# The region helpers below this block are pjit-style (sharding-constraint
+# driven). INSIDE `shard_map` the collectives must be explicit — and a bare
+# `lax.psum` is a gradient trap there: with replication checking disabled
+# (check_rep/check_vma False, which ring attention and the SPMD pipeline
+# need), psum's transpose is psum, so the backward double-counts. These
+# custom-vjp pairs pin Megatron's exact semantics:
+#   f: identity forward,  psum backward   (input of a column-parallel layer)
+#   g: psum forward,      identity backward (output of a row-parallel layer)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def copy_to_tp_region(x, axis_name=MODEL_AXIS):
+    """Megatron f for shard_map code: identity fwd, psum-over-axis bwd."""
+    return x
+
+
+def _copy_tp_fwd(x, axis_name):
+    return x, None
+
+
+def _copy_tp_bwd(axis_name, _, g):
+    return (jax.lax.psum(g, axis_name),)
+
+
+copy_to_tp_region.defvjp(_copy_tp_fwd, _copy_tp_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def reduce_from_tp_region(x, axis_name=MODEL_AXIS):
+    """Megatron g for shard_map code: psum fwd, identity bwd. Use this, not
+    a bare lax.psum, to complete a row-parallel matmul."""
+    return jax.lax.psum(x, axis_name)
+
+
+def _reduce_tp_fwd(x, axis_name):
+    return jax.lax.psum(x, axis_name), None
+
+
+def _reduce_tp_bwd(axis_name, _, g):
+    return (g,)
+
+
+reduce_from_tp_region.defvjp(_reduce_tp_fwd, _reduce_tp_bwd)
 
 
 def copy_to_model_parallel_region(x, mesh=None):

@@ -39,7 +39,37 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ...ops.ring_attention import _SHMAP_CHECK_KWARGS, shard_map
-from ...parallel.topology import PIPE_AXIS
+from ...parallel.topology import DATA_AXIS, PIPE_AXIS
+
+
+def _opt_specs_like(opt_state, params, p_spec):
+    """Optimizer-state specs: any subtree structured like the params pytree
+    (exp_avg, exp_avg_sq, momenta...) inherits the full param spec tree;
+    scalars (step counters) stay replicated; other array leaves fall back to
+    shape-matching a param spec."""
+    pt = jax.tree.structure(params)
+    flat_specs = jax.tree.leaves(p_spec, is_leaf=lambda x: isinstance(x, P))
+    shape_of = {}
+    for pleaf, sp in zip(jax.tree.leaves(params), flat_specs):
+        shape_of.setdefault(pleaf.shape, sp)
+
+    def walk(node):
+        try:
+            if jax.tree.structure(node) == pt:
+                return p_spec
+        except Exception:
+            pass
+        if hasattr(node, "_fields"):  # NamedTuple (AdamState etc.)
+            return type(node)(*[walk(c) for c in node])
+        if isinstance(node, (list, tuple)):
+            return type(node)(walk(c) for c in node)
+        if isinstance(node, dict):
+            return {k: walk(v) for k, v in node.items()}
+        if jnp.ndim(node) == 0:
+            return P()
+        return shape_of.get(node.shape, P(*([None] * jnp.ndim(node))))
+
+    return walk(opt_state)
 
 
 def _shard_map(fn, mesh, in_specs, out_specs):
@@ -122,20 +152,33 @@ def make_spmd_pipeline(stage_fn: Callable, num_stages: int, micro_batches: int,
 def make_spmd_pipeline_train_step(stage_fn: Callable, loss_fn: Callable,
                                   optimizer, num_stages: int,
                                   micro_batches: int, mesh: Mesh,
-                                  remat: bool = True):
-    """Fully-fused pipelined train step.
+                                  remat: bool = True,
+                                  param_specs=None):
+    """Fully-fused pipelined train step — composes PP x DP x TP on one mesh.
 
     loss_fn(outputs, labels) -> scalar (outputs: (M, mb, ...)).
     optimizer: functional (init/update) optimizer; its state mirrors the
-    params' pipe sharding, so each stage updates only its own shard.
+    params' sharding, so each stage/TP shard updates only its own slice.
     Returns jitted (params, opt_state, microbatches, labels, lr)
     -> ((new_params, new_opt_state), loss).
+
+    3D composition:
+      * ``param_specs``: optional PartitionSpec pytree for the stage params
+        (every leaf MUST lead with the '{pipe}' axis; add 'model' entries for
+        megatron-style TP — the stage_fn is then responsible for its own
+        psum over 'model' after row-parallel matmuls, the shard_map
+        contract). Default: pipe-sharded leading axis only.
+      * a 'data' mesh axis shards the micro-batch dimension; the loss is
+        pmean'd over it inside the program so gradients psum automatically
+        through AD (this is ZeRO-0 DP; pair with ZeRO-style sharded
+        optimizer states by passing sharded opt specs via param_specs).
     """
     assert PIPE_AXIS in mesh.axis_names, f"mesh needs a '{PIPE_AXIS}' axis"
     assert mesh.shape[PIPE_AXIS] == num_stages, (
         f"mesh '{PIPE_AXIS}' axis is {mesh.shape[PIPE_AXIS]}, "
         f"expected num_stages={num_stages}"
     )
+    data_parallel = DATA_AXIS in mesh.axis_names and mesh.shape[DATA_AXIS] > 1
     fwd_body = partial(_pipeline_body, stage_fn=stage_fn,
                        num_stages=num_stages, micro_batches=micro_batches,
                        remat=remat)
@@ -146,6 +189,10 @@ def make_spmd_pipeline_train_step(stage_fn: Callable, loss_fn: Callable,
         # stage holds real outputs; broadcast its value to all stages so the
         # gradient flows back through the ppermute chain
         loss = loss_fn(outputs, labels)
+        if data_parallel:
+            # averaging INSIDE the program makes AD insert the gradient
+            # psum over the data axis (ZeRO-0 DP)
+            loss = jax.lax.pmean(loss, DATA_AXIS)
         return loss
 
     def step(params, opt_state, microbatches, labels, lr):
@@ -154,6 +201,14 @@ def make_spmd_pipeline_train_step(stage_fn: Callable, loss_fn: Callable,
                 return compute_loss(p, microbatches, labels)
 
             loss, grads = jax.value_and_grad(loss_of)(params)
+            if data_parallel:
+                # shard_map leaves each data shard with the grads of its
+                # OWN local-mean loss (the in-loss pmean's backward is
+                # psum(1/N) = 1 per shard under disabled replication
+                # checking): average them for the global-batch grad mean.
+                # A psum here would scale the effective lr by dp — caught
+                # by the SGD-based equivalence test.
+                grads = jax.lax.pmean(grads, DATA_AXIS)
             # the loss lives on the last stage (other stages' local loss is
             # over zeros); grads already flowed back through the rotation.
             # Broadcast the real value to every stage for logging.
@@ -166,20 +221,23 @@ def make_spmd_pipeline_train_step(stage_fn: Callable, loss_fn: Callable,
                                                    lr=lr)
             return new_params, new_opt, loss
 
-        p_spec = jax.tree.map(lambda _: P(PIPE_AXIS), params)
-        o_spec = jax.tree.map(lambda _: P(PIPE_AXIS), opt_state)
-
-        def scalar_spec(tree, spec_tree):
-            # optimizer states may carry unsharded scalars (step counters)
-            return jax.tree.map(
-                lambda leaf, s: P() if jnp.ndim(leaf) == 0 else s,
-                tree, spec_tree,
-            )
-
-        o_spec = scalar_spec(opt_state, o_spec)
+        if param_specs is None:
+            p_spec = jax.tree.map(lambda _: P(PIPE_AXIS), params)
+        else:
+            p_spec = param_specs
+            for leaf in jax.tree.leaves(p_spec,
+                                        is_leaf=lambda x: isinstance(x, P)):
+                assert tuple(leaf)[:1] == (PIPE_AXIS,), (
+                    f"every param spec must lead with '{PIPE_AXIS}' "
+                    f"(stage axis); got {leaf}"
+                )
+        # optimizer-state leaves inherit their param's spec; scalars (step
+        # counters) stay replicated
+        o_spec = _opt_specs_like(opt_state, params, p_spec)
+        mb_spec = P(None, DATA_AXIS) if data_parallel else P()
         mapped = _shard_map(
             sharded_step, mesh,
-            (p_spec, o_spec, P(), P(), P()),
+            (p_spec, o_spec, mb_spec, mb_spec, P()),
             (p_spec, o_spec, P()),
         )
         new_params, new_opt, loss = mapped(params, opt_state, microbatches,
