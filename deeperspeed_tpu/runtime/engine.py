@@ -198,6 +198,7 @@ class Engine:
         self._grad_acc = None  # banked grads between backward() and step()
         self._acc_count = 0
         self._pending_metrics = None
+        self._lr_override = None  # set_lr pin; cleared by scheduler steps
 
         self._loss_scaler = create_loss_scaler(
             config.precision,
@@ -442,7 +443,73 @@ class Engine:
     def is_gradient_accumulation_boundary(self):
         return (self.micro_steps + 1) % self.gradient_accumulation_steps() == 0
 
+    def get_batch_info(self):
+        """(train_batch_size, micro_batch_per_gpu, grad_accum_steps) —
+        reference engine.py:256."""
+        return (self._config.train_batch_size,
+                self._config.train_micro_batch_size_per_gpu,
+                self._config.gradient_accumulation_steps)
+
+    def set_lr(self, lr):
+        """Pin the learning rate (reference _set_optimizer_param surface:
+        sets the lr directly; an active scheduler overwrites it again at its
+        next step(), same as torch param_groups)."""
+        self._client_lr = float(lr)
+        self._lr_override = float(lr)
+
+    def get_mom(self):
+        """Momentum/betas of the active optimizer (reference engine.py:1305)."""
+        opt = self.optimizer
+        if hasattr(opt, "momentum"):
+            return [opt.momentum]
+        if hasattr(opt, "betas"):
+            return [list(opt.betas)]
+        return None
+
+    def get_pld_theta(self):
+        if self.progressive_layer_drop is not None:
+            return self.progressive_layer_drop.get_theta()
+        return None
+
+    def elasticity_enabled(self):
+        return bool(getattr(self._config, "elasticity_enabled", False))
+
+    def memory_breakdown(self):
+        return getattr(self._config, "memory_breakdown", False)
+
+    def sparse_gradients_enabled(self):
+        return getattr(self._config, "sparse_gradients_enabled", False)
+
+    def wall_clock_breakdown(self):
+        return self._config.wall_clock_breakdown
+
+    def optimizer_name(self):
+        return self._config.optimizer_name
+
+    def optimizer_params(self):
+        return self._config.optimizer_params
+
+    def scheduler_name(self):
+        return self._config.scheduler_name
+
+    def scheduler_params(self):
+        return self._config.scheduler_params
+
+    def save_fp16_model(self, save_dir, save_filename="model_fp16.msgpack"):
+        """Save consolidated compute-dtype weights only (reference
+        engine.py:1882 — gathers ZeRO-3 shards first)."""
+        from ..checkpoint.serialization import save_tree
+
+        os.makedirs(save_dir, exist_ok=True)
+        host = self._zero3_consolidated_fp16_state_dict()
+        path = os.path.join(save_dir, save_filename)
+        save_tree(path, host)
+        log_dist(f"saved fp16 model weights to {path}", ranks=[0])
+        return path
+
     def _current_lr(self):
+        if self._lr_override is not None:
+            return self._lr_override
         if self.lr_scheduler is not None:
             return float(self.lr_scheduler.get_lr())
         return float(self._client_lr)
@@ -874,9 +941,11 @@ class Engine:
                 )
             elif self.lr_scheduler is not None:
                 self.lr_scheduler.step()
+                self._lr_override = None
         else:
             if self.lr_scheduler is not None:
                 self.lr_scheduler.step()
+                self._lr_override = None
 
     def train_batch(self, batch=None, data_iter=None):
         """Fused one-step API (the TPU-native hot path). Accepts either a full

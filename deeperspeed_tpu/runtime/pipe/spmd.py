@@ -54,6 +54,20 @@ def _opt_specs_like(opt_state, params, p_spec):
         shape_of.setdefault(pleaf.shape, sp)
 
     def walk(node):
+        is_container = (hasattr(node, "_fields")
+                        or isinstance(node, (list, tuple, dict)))
+        if not is_container:
+            # leaf: scalar counters FIRST — a 0-d leaf's tree structure
+            # equals a single-array params structure, which must not
+            # inherit the sharded spec
+            if jnp.ndim(node) == 0:
+                return P()
+            try:
+                if jax.tree.structure(node) == pt:
+                    return p_spec
+            except Exception:
+                pass
+            return shape_of.get(node.shape, P(*([None] * jnp.ndim(node))))
         try:
             if jax.tree.structure(node) == pt:
                 return p_spec
@@ -63,11 +77,7 @@ def _opt_specs_like(opt_state, params, p_spec):
             return type(node)(*[walk(c) for c in node])
         if isinstance(node, (list, tuple)):
             return type(node)(walk(c) for c in node)
-        if isinstance(node, dict):
-            return {k: walk(v) for k, v in node.items()}
-        if jnp.ndim(node) == 0:
-            return P()
-        return shape_of.get(node.shape, P(*([None] * jnp.ndim(node))))
+        return {k: walk(v) for k, v in node.items()}
 
     return walk(opt_state)
 

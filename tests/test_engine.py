@@ -1,5 +1,6 @@
 """End-to-end engine tests on the 8-device CPU mesh (parity with reference
 tests/unit/test_fp16.py + test_checkpointing.py basics)."""
+import os
 
 import jax
 import jax.numpy as jnp
@@ -379,3 +380,58 @@ class TestMasterlessBf16:
                 config={"train_micro_batch_size_per_gpu": 4,
                         "fp16": {"enabled": True, "master_weights": False}},
             )
+
+
+class TestReferenceAccessors:
+    """Reference engine accessor parity (engine.py:256-1315 surface)."""
+
+    def _engine(self):
+        eng, _, _, _ = ds.initialize(
+            model=lambda p, b: jnp.mean((b[0] @ p["w"] - b[1]) ** 2),
+            model_parameters={"w": jnp.ones((4, 1), jnp.float32)},
+            config={"train_batch_size": 16,
+                    "train_micro_batch_size_per_gpu": 2,
+                    "gradient_accumulation_steps": 1,
+                    "optimizer": {"type": "Adam",
+                                  "params": {"lr": 1e-2, "betas": [0.9, 0.98]}}},
+        )
+        return eng
+
+    def test_batch_info_and_params(self):
+        eng = self._engine()
+        assert eng.get_batch_info() == (16, 2, 1)
+        assert eng.get_mom() == [[0.9, 0.98]]
+        assert eng.optimizer_name().lower() == "adam"
+        assert eng.scheduler_name() is None
+        assert eng.elasticity_enabled() is False
+        assert eng.sparse_gradients_enabled() is False
+        assert eng.get_pld_theta() is None
+
+    def test_set_lr(self):
+        eng = self._engine()
+        eng.set_lr(5e-3)
+        assert eng.get_lr() == [5e-3]
+
+    def test_save_fp16_model(self, tmp_path):
+        eng = self._engine()
+        path = eng.save_fp16_model(str(tmp_path))
+        assert os.path.exists(path)
+
+    def test_set_lr_with_scheduler(self):
+        eng, _, _, _ = ds.initialize(
+            model=lambda p, b: jnp.mean((b[0] @ p["w"] - b[1]) ** 2),
+            model_parameters={"w": jnp.ones((4, 1), jnp.float32)},
+            config={"train_batch_size": 32,
+                    "train_micro_batch_size_per_gpu": 4,
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                    "scheduler": {"type": "WarmupLR",
+                                  "params": {"warmup_max_lr": 1e-2,
+                                             "warmup_num_steps": 100}}},
+        )
+        eng.set_lr(5e-3)
+        assert eng.get_lr() == [5e-3]  # pin holds before the next step
+        X = np.ones((32, 4), np.float32)
+        Y = np.zeros((32, 1), np.float32)
+        eng.train_batch((X, Y))
+        # the scheduler reclaims the lr at its step, like torch param_groups
+        assert eng.get_lr() != [5e-3]
