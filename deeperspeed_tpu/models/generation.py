@@ -30,8 +30,9 @@ from .gpt import GPTConfig, decoder_block, layer_norm
 
 
 def init_cache(cfg: GPTConfig, batch: int, max_len: int):
-    """Stacked per-layer KV cache: (L, B, max_len, H, Dh)."""
-    shape = (cfg.n_layer, batch, max_len, cfg.n_head, cfg.head_dim)
+    """Stacked per-layer KV cache: (L, B, max_len, Hkv, Dh) — GQA/MQA
+    models cache only their n_kv_head heads (n_head/n_kv_head x smaller)."""
+    shape = (cfg.n_layer, batch, max_len, cfg.kv_heads, cfg.head_dim)
     return {
         "k": jnp.zeros(shape, cfg.dtype),
         "v": jnp.zeros(shape, cfg.dtype),
@@ -42,13 +43,14 @@ def _cached_block(cfg: GPTConfig, x, layer_params, k_cache, v_cache,
                   offset, positions):
     """One decoder layer over S new tokens with a KV cache.
 
-    x: (B, S, D); k/v_cache: (B, max_len, H, Dh); offset: scalar — number of
-    tokens already cached. Returns (x_out, k_cache, v_cache). The layer math
-    is gpt.decoder_block; only the attention core differs (cache update +
-    absolute-position masking)."""
+    x: (B, S, D); k/v_cache: (B, max_len, Hkv, Dh) — n_kv_head heads for
+    GQA/MQA models; offset: scalar — number of tokens already cached.
+    Returns (x_out, k_cache, v_cache). The layer math is gpt.decoder_block;
+    only the attention core differs (cache update + absolute-position
+    masking)."""
     cdt = cfg.dtype
     Dh = cfg.head_dim
-    S = x.shape[1]
+    B_, S = x.shape[0], x.shape[1]
 
     def attend(q, k, v):
         k_c = jax.lax.dynamic_update_slice(
@@ -57,14 +59,21 @@ def _cached_block(cfg: GPTConfig, x, layer_params, k_cache, v_cache,
         v_c = jax.lax.dynamic_update_slice(
             v_cache, v.astype(cdt), (0, offset, 0, 0)
         )
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_c,
+        # grouped attention: q heads fold to (Hkv, rep) so the cached K/V
+        # are read at their small Hkv width — no materialized repeat (the
+        # HBM reads of K/V dominate decode cost)
+        Hq = q.shape[2]
+        rep = Hq // k_c.shape[2]
+        qg = q.reshape(B_, S, k_c.shape[2], rep, Dh)
+        scores = jnp.einsum("bqhrd,bkhd->bhrqk", qg, k_c,
                             preferred_element_type=jnp.float32)
         scores = scores / math.sqrt(Dh)
         key_pos = jnp.arange(k_c.shape[1])
         valid = key_pos[None, :] <= (offset + jnp.arange(S))[:, None]
-        scores = jnp.where(valid[None, None], scores, -1e30)
+        scores = jnp.where(valid[None, None, None], scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1).astype(cdt)
-        ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v_c)
+        ctx = jnp.einsum("bhrqk,bkhd->bqhrd", probs, v_c)
+        ctx = ctx.reshape(B_, S, Hq, Dh)
         return ctx, (k_c, v_c)
 
     moe_cfg = cfg.moe

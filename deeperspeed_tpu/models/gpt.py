@@ -40,6 +40,10 @@ class GPTConfig:
     vocab_size: int = 50304
     n_layer: int = 12
     n_head: int = 12
+    # grouped-query attention: number of K/V heads (0 = n_head = classic
+    # MHA; 1 = MQA). Shrinks the qkv projection and the decode KV cache by
+    # n_head/n_kv_head; attention repeats K/V heads to match Q
+    n_kv_head: int = 0
     d_model: int = 768
     d_ff: int = 0  # 0 => 4 * d_model
     max_seq: int = 1024
@@ -107,6 +111,19 @@ class GPTConfig:
         assert self.d_model % self.n_head == 0
         return self.d_model // self.n_head
 
+    @property
+    def kv_heads(self):
+        kv = self.n_kv_head or self.n_head
+        assert self.n_head % kv == 0, (
+            f"n_head ({self.n_head}) must be a multiple of n_kv_head ({kv})"
+        )
+        return kv
+
+    @property
+    def qkv_dim(self):
+        """Width of the fused qkv projection: H*Dh + 2*Hkv*Dh."""
+        return (self.n_head + 2 * self.kv_heads) * self.head_dim
+
 
 # ------------------------------------------------------------------ #
 # init
@@ -132,8 +149,8 @@ def init_params(rng, cfg: GPTConfig):
             "ln2_scale": jnp.ones((L, D), jnp.float32),
             "ln2_bias": jnp.zeros((L, D), jnp.float32),
             "attn": {
-                "wqkv": norm(next(k), (L, D, 3 * D), std),
-                "bqkv": jnp.zeros((L, 3 * D), jnp.float32),
+                "wqkv": norm(next(k), (L, D, cfg.qkv_dim), std),
+                "bqkv": jnp.zeros((L, cfg.qkv_dim), jnp.float32),
                 "wo": norm(next(k), (L, D, D), out_std),
                 "bo": jnp.zeros((L, D), jnp.float32),
             },
@@ -277,6 +294,19 @@ def _xla_causal_attention(q, k, v):
 _ATTN_IMPLS = ("auto", "pallas", "pallas_interpret", "xla", "ring", "ulysses")
 
 
+def expand_kv_heads(q, k, v):
+    """GQA: repeat K/V heads to match Q's head count (q head i attends to
+    kv head i // rep, the HF repeat_kv convention). The projection and the
+    decode cache keep the small Hkv; full-H tensors only exist transiently
+    for the attention kernels. The decode path avoids even that via a
+    grouped einsum (models/generation.py)."""
+    if k.shape[2] != q.shape[2]:
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    return k, v
+
+
 def causal_attention(q, k, v, impl="auto"):
     if impl not in _ATTN_IMPLS:
         raise ValueError(f"unknown attn_impl {impl!r}; choose from {_ATTN_IMPLS}")
@@ -332,8 +362,10 @@ def decoder_block(cfg: GPTConfig, mesh, x, layer_params, positions, attend,
     qkv = attn_in @ layer_params["attn"]["wqkv"].astype(cdt) + layer_params[
         "attn"
     ]["bqkv"].astype(cdt)
-    qkv = qkv.reshape(B, S, 3, H, Dh)
-    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    Hkv = cfg.kv_heads
+    q = qkv[..., : H * Dh].reshape(B, S, H, Dh)
+    k = qkv[..., H * Dh: (H + Hkv) * Dh].reshape(B, S, Hkv, Dh)
+    v = qkv[..., (H + Hkv) * Dh:].reshape(B, S, Hkv, Dh)
     if cfg.rotary:
         rd = int(cfg.rotary_pct * Dh) // 2 * 2
         q = rotary_embedding(q, positions, rd)
@@ -405,6 +437,7 @@ def make_gpt(cfg: GPTConfig, mesh=None):
         )
 
     def attend(q, k, v):
+        k, v = expand_kv_heads(q, k, v)
         q = _shard_act(q, mesh, P(DATA_AXIS, SEQ_AXIS, MODEL_AXIS, None))
         k = _shard_act(k, mesh, P(DATA_AXIS, SEQ_AXIS, MODEL_AXIS, None))
         v = _shard_act(v, mesh, P(DATA_AXIS, SEQ_AXIS, MODEL_AXIS, None))
