@@ -95,6 +95,12 @@ class GPTConfig:
         )
 
     def __post_init__(self):
+        kv = self.n_kv_head or self.n_head
+        if self.n_head % kv:
+            raise ValueError(
+                f"n_head ({self.n_head}) must be a multiple of n_kv_head "
+                f"({kv})"
+            )
         if self.remat_policy not in ("full", "flash", "matmuls", "dots",
                                      "dots_all"):
             raise ValueError(
@@ -113,11 +119,7 @@ class GPTConfig:
 
     @property
     def kv_heads(self):
-        kv = self.n_kv_head or self.n_head
-        assert self.n_head % kv == 0, (
-            f"n_head ({self.n_head}) must be a multiple of n_kv_head ({kv})"
-        )
-        return kv
+        return self.n_kv_head or self.n_head  # validated in __post_init__
 
     @property
     def qkv_dim(self):
@@ -562,6 +564,82 @@ def make_gpt(cfg: GPTConfig, mesh=None):
         return init_params(rng, cfg)
 
     return init_fn, apply_fn, loss_fn, param_specs(cfg)
+
+
+def params_from_hf(model, cfg: Optional[GPTConfig] = None):
+    """Import a huggingface GPT2LMHeadModel/GPT2Model checkpoint into the
+    stacked param pytree (the GPT-family counterpart of
+    bert.params_from_hf), giving bit-compatible fine-tuning starts.
+
+    HF GPT-2's Conv1D weights are already (in, out), matching this module's
+    layout; c_attn's fused q|k|v column order matches the wqkv split.
+    Returns (cfg, params) with tie_embeddings=True (HF GPT-2 ties lm_head
+    to wte)."""
+    from ..ops.transformer.transformer import to_numpy_f32
+
+    def f32(t):
+        return jnp.asarray(to_numpy_f32(t))
+
+    gpt2 = getattr(model, "transformer", model)
+    hf_cfg = model.config
+    if cfg is None:
+        cfg = GPTConfig(
+            vocab_size=hf_cfg.vocab_size,
+            n_layer=hf_cfg.n_layer,
+            n_head=hf_cfg.n_head,
+            d_model=hf_cfg.n_embd,
+            max_seq=hf_cfg.n_positions,
+            rotary=False,
+            parallel_residual=False,
+            tie_embeddings=True,
+            layernorm_eps=hf_cfg.layer_norm_epsilon,
+            dtype=jnp.float32,
+        )
+    if cfg.rotary or cfg.parallel_residual:
+        raise ValueError(
+            "HF GPT-2 is learned-position + serial-residual; pass a "
+            "matching cfg"
+        )
+    if (cfg.kv_heads != cfg.n_head or cfg.n_head != hf_cfg.n_head
+            or cfg.d_model != hf_cfg.n_embd or cfg.n_layer != hf_cfg.n_layer):
+        raise ValueError(
+            f"cfg (layers={cfg.n_layer}, d={cfg.d_model}, heads="
+            f"{cfg.n_head}, kv_heads={cfg.kv_heads}) does not match the HF "
+            f"checkpoint (layers={hf_cfg.n_layer}, d={hf_cfg.n_embd}, "
+            f"heads={hf_cfg.n_head}, MHA) — GQA cannot import MHA weights"
+        )
+
+    blocks = list(gpt2.h)
+    stack = lambda ts: jnp.stack([f32(t) for t in ts])
+    params = {
+        "embed": {
+            "wte": f32(gpt2.wte.weight),
+            "wpe": f32(gpt2.wpe.weight),
+        },
+        "layers": {
+            "ln1_scale": stack([b.ln_1.weight for b in blocks]),
+            "ln1_bias": stack([b.ln_1.bias for b in blocks]),
+            "ln2_scale": stack([b.ln_2.weight for b in blocks]),
+            "ln2_bias": stack([b.ln_2.bias for b in blocks]),
+            "attn": {
+                "wqkv": stack([b.attn.c_attn.weight for b in blocks]),
+                "bqkv": stack([b.attn.c_attn.bias for b in blocks]),
+                "wo": stack([b.attn.c_proj.weight for b in blocks]),
+                "bo": stack([b.attn.c_proj.bias for b in blocks]),
+            },
+            "mlp": {
+                "wi": stack([b.mlp.c_fc.weight for b in blocks]),
+                "bi": stack([b.mlp.c_fc.bias for b in blocks]),
+                "wo": stack([b.mlp.c_proj.weight for b in blocks]),
+                "bo": stack([b.mlp.c_proj.bias for b in blocks]),
+            },
+        },
+        "final_ln": {
+            "scale": f32(gpt2.ln_f.weight),
+            "bias": f32(gpt2.ln_f.bias),
+        },
+    }
+    return cfg, params
 
 
 # convenience presets ------------------------------------------------- #

@@ -213,3 +213,35 @@ class TestGQA:
         cfg = self._cfg(0)  # n_kv_head=0 -> classic MHA
         params = init_params(jax.random.PRNGKey(0), cfg)
         assert params["layers"]["attn"]["wqkv"].shape == (2, 32, 3 * 32)
+
+
+class TestHFImport:
+    """GPT-2 checkpoint import: logits parity vs huggingface (the GPT-family
+    counterpart of tests/test_bert.py's HF parity; reference kernel tests
+    compare against HF layers the same way, tests/unit/test_cuda_forward.py)."""
+
+    def test_gpt2_logits_match_hf(self):
+        transformers = pytest.importorskip("transformers")
+        torch = pytest.importorskip("torch")
+        from transformers.models.gpt2.configuration_gpt2 import GPT2Config
+        from transformers.models.gpt2.modeling_gpt2 import GPT2LMHeadModel
+
+        hf_cfg = GPT2Config(vocab_size=96, n_positions=32, n_embd=32,
+                            n_layer=2, n_head=4, resid_pdrop=0.0,
+                            embd_pdrop=0.0, attn_pdrop=0.0)
+        torch.manual_seed(0)
+        hf = GPT2LMHeadModel(hf_cfg).eval()
+
+        from deeperspeed_tpu.models.gpt import params_from_hf
+
+        import dataclasses
+
+        cfg, params = params_from_hf(hf)
+        cfg = dataclasses.replace(cfg, attn_impl="xla", remat=False)
+        _, apply_fn, _, _ = make_gpt(cfg)
+
+        ids = np.random.default_rng(0).integers(0, 96, (2, 16), dtype=np.int64)
+        with torch.no_grad():
+            ref = hf(torch.from_numpy(ids)).logits.numpy()
+        ours = np.asarray(apply_fn(params, jnp.asarray(ids, jnp.int32)))
+        np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=2e-4)
