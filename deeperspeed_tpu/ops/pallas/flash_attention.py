@@ -72,11 +72,15 @@ def _compiler_params(interpret, n_parallel, semantics=None):
 
 
 def _auto_block(S, default):
-    """Largest multiple-of-8 block <= default that divides S; whole-S block
-    as the fallback (a block equal to the full dim always tiles, but only
-    fits VMEM for small S — is_available gates the auto path on that)."""
+    """Largest multiple-of-128 block <= default that divides S; whole-S
+    block as the fallback (a block equal to the full dim always tiles, but
+    only fits VMEM for small S — is_available gates the auto path on that).
+
+    Multiple of 128, not 8: block_q is also the LANE dim of the lse/delta
+    BlockSpecs, and lane-dim blocks must be 128-divisible or span the full
+    array (caught by scripts/tpu_smoke.py at S=640)."""
     b = min(default, S)
-    for d in range(b - b % 8, 127, -8):
+    for d in range(b - b % 128, 127, -128):
         if S % d == 0:
             return d
     return S
@@ -97,7 +101,7 @@ def is_available(q) -> bool:
         return False
     # the auto-picked blocks must also FIT: the (block_q, block_k) fp32
     # scores tile lives in VMEM, so a whole-S fallback at large awkward S
-    # (no multiple-of-8 divisor in [128, default]) must fall back to XLA
+    # (no multiple-of-128 divisor in [128, default]) must fall back to XLA
     bq = _auto_block(S, DEFAULT_BLOCK_Q)
     bk = _auto_block(S, DEFAULT_BLOCK_K)
     return bq * bk * 4 <= 8 * 1024 * 1024

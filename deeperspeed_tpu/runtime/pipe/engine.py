@@ -733,6 +733,23 @@ class PipelineEngine:
     def train_micro_batch_size_per_gpu(self):
         return self._config.train_micro_batch_size_per_gpu
 
+    def get_batch_info(self):
+        return (self._config.train_batch_size,
+                self._config.train_micro_batch_size_per_gpu,
+                self._config.gradient_accumulation_steps)
+
+    def zero_optimization_stage(self):
+        return self._config.zero_optimization_stage
+
+    def wall_clock_breakdown(self):
+        return self._config.wall_clock_breakdown
+
+    def optimizer_name(self):
+        return self._config.optimizer_name
+
+    def scheduler_name(self):
+        return self._config.scheduler_name
+
     def is_gradient_accumulation_boundary(self):
         return True
 

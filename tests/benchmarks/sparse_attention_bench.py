@@ -1,0 +1,60 @@
+"""Block-sparse vs dense-flash attention micro-benchmark (the rebuild's
+counterpart of the reference's unscored tests/benchmarks scripts).
+
+Run on hardware:  python tests/benchmarks/sparse_attention_bench.py
+Prints ms/call and the sparse-vs-dense speedup per sequence length; the
+crossover moves left as sparsity rises (fewer local blocks / longer S).
+"""
+
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from deeperspeed_tpu.ops.pallas.flash_attention import flash_attention
+    from deeperspeed_tpu.ops.sparse_attention.kernels import (
+        make_block_sparse_attention)
+    from deeperspeed_tpu.ops.sparse_attention.sparsity_config import (
+        FixedSparsityConfig)
+
+    B, H, Dh = 1, 8, 64
+    interpret = jax.devices()[0].platform != "tpu"
+
+    def timeit(fn, n=8):
+        r = fn()
+        float(jax.device_get(jnp.sum(jax.tree.leaves(r)[0].astype(jnp.float32))))
+        t0 = time.perf_counter()
+        for _ in range(n):
+            r = fn()
+        float(jax.device_get(jnp.sum(jax.tree.leaves(r)[0].astype(jnp.float32))))
+        return (time.perf_counter() - t0) / n
+
+    print(f"{'S':>7} {'density':>8} {'sparse ms':>10} {'dense ms':>9} {'speedup':>8}")
+    for S in (2048, 4096, 8192, 16384):
+        cfg = FixedSparsityConfig(num_heads=H, block=128, num_local_blocks=4,
+                                  num_global_blocks=1,
+                                  attention="unidirectional")
+        layout = np.asarray(cfg.make_layout(S))
+        fn = make_block_sparse_attention(layout, 128, causal=True,
+                                         interpret=interpret)
+        q = jax.random.normal(jax.random.PRNGKey(0), (B, S, H, Dh),
+                              jnp.bfloat16)
+        t_sp = timeit(jax.jit(lambda q=q, fn=fn: fn(q, q, q)))
+        try:
+            t_fl = timeit(jax.jit(
+                lambda q=q: flash_attention(q, q, q, causal=True,
+                                            interpret=interpret)))
+            speed = f"{t_fl / t_sp:7.2f}x"
+            dense = f"{t_fl * 1e3:9.2f}"
+        except Exception:
+            dense, speed = "OOM/fail", "inf"
+        print(f"{S:7d} {layout.mean():8.3f} {t_sp * 1e3:10.2f} {dense:>9} "
+              f"{speed:>8}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

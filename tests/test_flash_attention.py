@@ -106,3 +106,21 @@ def test_mismatched_block_sizes():
             np.asarray(out), np.asarray(ref), atol=2e-3, rtol=2e-3,
             err_msg=f"bq={bq} bk={bk}",
         )
+
+
+def test_auto_block_is_lane_legal():
+    """Auto blocks must be 128-multiples (block_q becomes the LANE dim of
+    the lse/delta BlockSpecs) or span the whole sequence — regression guard
+    for the S=640 Mosaic lowering failure scripts/tpu_smoke.py caught
+    (interpret mode does not enforce the lane rule, so this must be a
+    pure-Python check)."""
+    from deeperspeed_tpu.ops.pallas.flash_attention import _auto_block
+
+    assert _auto_block(640, 512) == 128
+    assert _auto_block(1024, 512) == 512
+    assert _auto_block(1016, 512) == 1016  # 8*127: whole-S fallback
+    for S in range(128, 4097, 8):
+        for default in (128, 256, 512):
+            b = _auto_block(S, default)
+            assert b % 128 == 0 or b == S, (S, default, b)
+            assert S % b == 0, (S, default, b)
