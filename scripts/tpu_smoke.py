@@ -30,9 +30,9 @@ def _check(name, fn):
         ))
         assert np.isfinite(tot), f"non-finite output {tot}"
         print(f"  {name:44s} OK  (checksum {tot:.4g})", flush=True)
-    except Exception as e:  # noqa: BLE001 — report and fail the script
-        print(f"  {name:44s} FAIL: {str(e)[:140]}", flush=True)
-        raise SystemExit(1)
+    except Exception:  # noqa: BLE001 — summary line, then the full evidence
+        print(f"  {name:44s} FAIL — full traceback follows", flush=True)
+        raise
 
 
 def main():

@@ -22,7 +22,11 @@ def main():
         FixedSparsityConfig)
 
     B, H, Dh = 1, 8, 64
-    interpret = jax.devices()[0].platform != "tpu"
+    if jax.devices()[0].platform != "tpu":
+        # interpret-mode Pallas runs every grid step in Python — the long-S
+        # sweep would take hours; this benchmark is hardware-only
+        print("no TPU visible — run this benchmark on hardware")
+        return
 
     def timeit(fn, n=8):
         r = fn()
@@ -39,15 +43,13 @@ def main():
                                   num_global_blocks=1,
                                   attention="unidirectional")
         layout = np.asarray(cfg.make_layout(S))
-        fn = make_block_sparse_attention(layout, 128, causal=True,
-                                         interpret=interpret)
+        fn = make_block_sparse_attention(layout, 128, causal=True)
         q = jax.random.normal(jax.random.PRNGKey(0), (B, S, H, Dh),
                               jnp.bfloat16)
         t_sp = timeit(jax.jit(lambda q=q, fn=fn: fn(q, q, q)))
         try:
             t_fl = timeit(jax.jit(
-                lambda q=q: flash_attention(q, q, q, causal=True,
-                                            interpret=interpret)))
+                lambda q=q: flash_attention(q, q, q, causal=True)))
             speed = f"{t_fl / t_sp:7.2f}x"
             dense = f"{t_fl * 1e3:9.2f}"
         except Exception:
