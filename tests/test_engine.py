@@ -435,3 +435,26 @@ class TestReferenceAccessors:
         eng.train_batch((X, Y))
         # the scheduler reclaims the lr at its step, like torch param_groups
         assert eng.get_lr() != [5e-3]
+
+@pytest.mark.parametrize("stage", [1, 2])
+def test_masterless_composes_with_zero(stage):
+    """Masterless bf16 + ZeRO: moments shard over the data axis while
+    the bf16 params stay per the param specs — training converges."""
+    init, loss_fn = TestMasterlessBf16._model()
+    eng, _, _, _ = ds.initialize(
+        model=loss_fn, model_parameters=init(jax.random.PRNGKey(0)),
+        config={"train_micro_batch_size_per_gpu": 2,
+                "gradient_accumulation_steps": 1,
+                "bf16": {"enabled": True, "master_weights": False},
+                "zero_optimization": {"stage": stage},
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                "gradient_clipping": 1.0},
+    )
+    assert eng.state.master is None
+    rng = np.random.default_rng(0)
+    W = rng.normal(size=(16, 1)).astype(np.float32)
+    losses = []
+    for _ in range(25):
+        X = rng.normal(size=(16, 16)).astype(np.float32)
+        losses.append(float(jax.device_get(eng.train_batch((X, X @ W)))))
+    assert losses[-1] < losses[0] / 2, losses
