@@ -9,8 +9,9 @@ A schedule yields, per step, the list of instructions one stage executes;
 steps are barrier-aligned across stages (a send on stage ``s`` at step ``t``
 pairs with the recv on ``s±1`` at the same ``t``). The TPU engine executes
 these host-side (driving per-stage jitted programs + device-to-device
-transfers); the fully-jitted SPMD pipeline (pipe/spmd.py) compiles the same
-1F1B dataflow into one XLA program and is preferred on the hot path.
+transfers); the fully-jitted SPMD pipeline (pipe/spmd.py) compiles a 1F1B
+schedule of its own — same O(stages) in-flight-activation bound, expressed
+as one XLA program — and is preferred on the hot path.
 """
 
 from abc import ABC, abstractmethod

@@ -5,16 +5,30 @@ The host-driven PipelineEngine (engine.py) is the schedule-faithful,
 API-complete path mirroring the reference's instruction streams
 (/root/reference/deepspeed/runtime/pipe/engine.py:1295). This module is the
 TPU-native fast path the reference cannot express: all stages run the SAME
-program over the 'pipe' mesh axis (shard_map), activations rotate between
-neighbor stages with `lax.ppermute`, and the full GPipe dataflow —
-M microbatches through S stages in M+S-1 waves, forward AND backward — is
-compiled and software-pipelined by XLA. Autodiff through the scan+ppermute
-yields the backward schedule automatically; per-wave remat keeps activation
-memory at one stage-activation per in-flight microbatch.
+program over the 'pipe' mesh axis (shard_map) and activations rotate between
+neighbor stages with `lax.ppermute`. Two schedules:
+
+* ``schedule="1f1b"`` (default, training): a hand-scheduled one-forward-
+  one-backward dataflow with an explicit per-stage backward (`jax.vjp` per
+  slot, remat-style recompute from the saved stage INPUT only). Each global
+  tick every stage runs one forward and one backward slot; saved
+  activations live in a ring buffer of 2S-1 slots, so peak activation
+  memory is O(stages) and FLAT in the number of microbatches — the memory
+  property of the reference's ``TrainSchedule``
+  (/root/reference/deepspeed/runtime/pipe/schedule.py:246), expressed as a
+  single compiled scan instead of a host instruction stream.
+* ``schedule="gpipe"``: GPipe dataflow — M microbatches through S stages in
+  M+S-1 waves, with XLA autodiff through the scan+ppermute deriving the
+  backward. Simpler, bit-exact against plain autodiff, but keeps ~M
+  stage-activations live during the backward sweep; use for parity checks
+  or small M.
 
 Requirements: homogeneous stages (every stage applies the same `stage_fn`
 with its own params; activations keep one shape), the natural fit for
-scan-over-blocks transformers.
+scan-over-blocks transformers. The 1f1b schedule additionally requires the
+loss to decompose over microbatches: ``loss_fn`` over the full (M, mb, ...)
+batch must equal the mean of per-microbatch losses (true for mean-reduced
+losses like cross-entropy/MSE).
 
 Usage::
 
@@ -136,6 +150,97 @@ def _pipeline_body(stage_params, microbatches, *, stage_fn, num_stages,
     return outputs[None]  # leading pipe-sharded axis for out_specs
 
 
+def _pipeline_1f1b_grads(stage_params, microbatches, labels, *, stage_fn,
+                         loss_fn, num_stages, micro_batches):
+    """Runs inside shard_map; hand-scheduled 1F1B with explicit backward.
+
+    Global clock of T = M + 2(S-1) ticks; at tick t stage s runs
+      F slot: forward of microbatch  m_f = t - s
+      B slot: backward of microbatch m_b = t - 2(S-1) + s
+    (slots outside [0, M) are masked). The last stage's B slot consumes the
+    loss gradient of the microbatch it forwarded THIS tick — the 1F1B
+    trigger — so a microbatch's stage-input is live for only 2(S-1-s) ticks
+    and a ring buffer of 2S-1 slots bounds saved activations at O(S),
+    independent of M. The backward slot recomputes the stage forward from
+    the saved input via `jax.vjp` (remat), mirroring the per-stage
+    fwd-recompute+bwd cost of activation-checkpointed pipeline training.
+
+    Returns (grads_with_stage_axis, loss): grads summed over this stage's M
+    backward slots and scaled 1/M; loss is the mean per-microbatch loss,
+    nonzero only on the last stage (caller broadcasts over the pipe axis).
+    """
+    S, M = num_stages, micro_batches
+    stage = jax.lax.axis_index(PIPE_AXIS)
+    params_local = jax.tree.map(lambda p: p[0], stage_params)
+
+    act = jax.eval_shape(stage_fn, params_local, microbatches[0])
+    nslots = 2 * S - 1
+    fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+    bwd_perm = [(i, (i - 1) % S) for i in range(S)]
+    inv_m = jnp.float32(1.0 / M)
+
+    def scaled_loss(y, label):
+        # per-microbatch contribution to the mean-over-microbatches loss;
+        # loss_fn sees a leading axis of 1 so mean-reduced losses compose
+        return loss_fn(y[None], label[None]) * inv_m
+
+    def tick(carry, t):
+        saved, fwd_in, bwd_in, gacc, lacc = carry
+
+        # ---- forward slot ----
+        m_f = t - stage
+        f_valid = jnp.logical_and(m_f >= 0, m_f < M)
+        mf_idx = jnp.clip(m_f, 0, M - 1)
+        x = jnp.where(stage == 0, microbatches[mf_idx].astype(act.dtype),
+                      fwd_in)
+        y = stage_fn(params_local, x)
+        slot_f = jnp.remainder(mf_idx, nslots)
+        saved = jnp.where(
+            f_valid,
+            jax.lax.dynamic_update_index_in_dim(saved, x, slot_f, 0),
+            saved,
+        )
+
+        # ---- backward slot ----
+        m_b = t - 2 * (S - 1) + stage
+        b_valid = jnp.logical_and(m_b >= 0, m_b < M)
+        mb_idx = jnp.clip(m_b, 0, M - 1)
+        x_b = jax.lax.dynamic_index_in_dim(
+            saved, jnp.remainder(mb_idx, nslots), 0, keepdims=False)
+        # last stage: this tick's own forward output feeds the loss grad
+        # (m_b == m_f there); other stages consume the rotated upstream grad
+        loss_m, dy_loss = jax.value_and_grad(scaled_loss)(
+            y, labels[mb_idx])
+        y_b, vjp_fn = jax.vjp(stage_fn, params_local, x_b)
+        dy = jnp.where(stage == S - 1, dy_loss.astype(y_b.dtype),
+                       bwd_in.astype(y_b.dtype))
+        dparams, dx = vjp_fn(dy)
+        gacc = jax.tree.map(
+            lambda a, g: a + jnp.where(b_valid, g.astype(a.dtype), 0.0),
+            gacc, dparams)
+        lacc = lacc + jnp.where(
+            jnp.logical_and(b_valid, stage == S - 1),
+            loss_m.astype(lacc.dtype), 0.0)
+
+        fwd_next = jax.lax.ppermute(y, PIPE_AXIS, fwd_perm)
+        bwd_next = jax.lax.ppermute(dx.astype(act.dtype), PIPE_AXIS,
+                                    bwd_perm)
+        return (saved, fwd_next, bwd_next, gacc, lacc), None
+
+    saved0 = jnp.zeros((nslots,) + act.shape, act.dtype)
+    fwd0 = jnp.zeros(act.shape, act.dtype)
+    bwd0 = jnp.zeros(act.shape, act.dtype)
+    gacc0 = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
+                         params_local)
+    lacc0 = jnp.float32(0.0)
+    T = M + 2 * (S - 1)
+    (_, _, _, grads, loss), _ = jax.lax.scan(
+        tick, (saved0, fwd0, bwd0, gacc0, lacc0), jnp.arange(T))
+    grads = jax.tree.map(
+        lambda g, p: g.astype(p.dtype)[None], grads, params_local)
+    return grads, loss
+
+
 def make_spmd_pipeline(stage_fn: Callable, num_stages: int, micro_batches: int,
                        mesh: Mesh, remat: bool = True):
     """jitted (stage_params, microbatches) -> last-stage outputs (M, mb, ...).
@@ -163,7 +268,8 @@ def make_spmd_pipeline_train_step(stage_fn: Callable, loss_fn: Callable,
                                   optimizer, num_stages: int,
                                   micro_batches: int, mesh: Mesh,
                                   remat: bool = True,
-                                  param_specs=None):
+                                  param_specs=None,
+                                  schedule: str = "1f1b"):
     """Fully-fused pipelined train step — composes PP x DP x TP on one mesh.
 
     loss_fn(outputs, labels) -> scalar (outputs: (M, mb, ...)).
@@ -171,6 +277,17 @@ def make_spmd_pipeline_train_step(stage_fn: Callable, loss_fn: Callable,
     params' sharding, so each stage/TP shard updates only its own slice.
     Returns jitted (params, opt_state, microbatches, labels, lr)
     -> ((new_params, new_opt_state), loss).
+
+    schedule: "1f1b" (default) — hand-scheduled one-forward-one-backward
+    with O(stages) live activations. CONTRACT: loss_fn over the full
+    (M, mb, ...) batch must equal the mean of its per-microbatch values
+    (true for mean-reduced losses; NOT for sum-reduced or
+    count-weighted/masked means whose weights vary per microbatch — those
+    get silently rescaled gradients). If unsure, pass schedule="gpipe":
+    autodiff through the forward wave scan, ~M live activations, but exact
+    for any loss_fn. ``remat`` applies to "gpipe" only; "1f1b" always
+    recomputes each stage forward from its saved input in the backward
+    slot (the activation-checkpointing cost model).
 
     3D composition:
       * ``param_specs``: optional PartitionSpec pytree for the stage params
@@ -188,10 +305,14 @@ def make_spmd_pipeline_train_step(stage_fn: Callable, loss_fn: Callable,
         f"mesh '{PIPE_AXIS}' axis is {mesh.shape[PIPE_AXIS]}, "
         f"expected num_stages={num_stages}"
     )
+    assert schedule in ("1f1b", "gpipe"), f"unknown schedule {schedule!r}"
     data_parallel = DATA_AXIS in mesh.axis_names and mesh.shape[DATA_AXIS] > 1
     fwd_body = partial(_pipeline_body, stage_fn=stage_fn,
                        num_stages=num_stages, micro_batches=micro_batches,
                        remat=remat)
+    grads_body = partial(_pipeline_1f1b_grads, stage_fn=stage_fn,
+                         loss_fn=loss_fn, num_stages=num_stages,
+                         micro_batches=micro_batches)
 
     def compute_loss(stage_params, microbatches, labels):
         outputs = fwd_body(stage_params, microbatches)[0]  # (M, mb, ...)
@@ -207,10 +328,18 @@ def make_spmd_pipeline_train_step(stage_fn: Callable, loss_fn: Callable,
 
     def step(params, opt_state, microbatches, labels, lr):
         def sharded_step(params, opt_state, microbatches, labels, lr):
-            def loss_of(p):
-                return compute_loss(p, microbatches, labels)
+            if schedule == "1f1b":
+                grads, loss = grads_body(params, microbatches, labels)
+                if data_parallel:
+                    # the 1f1b body's loss is this data-shard's local mean;
+                    # average it here (compute_loss does so in-program for
+                    # the gpipe path)
+                    loss = jax.lax.pmean(loss, DATA_AXIS)
+            else:
+                def loss_of(p):
+                    return compute_loss(p, microbatches, labels)
 
-            loss, grads = jax.value_and_grad(loss_of)(params)
+                loss, grads = jax.value_and_grad(loss_of)(params)
             if data_parallel:
                 # shard_map leaves each data shard with the grads of its
                 # OWN local-mean loss (the in-loss pmean's backward is
