@@ -79,6 +79,8 @@ class GPTConfig:
     moe_capacity_factor: float = 1.25
     moe_aux_coef: float = 0.01
     moe_z_coef: float = 1e-3
+    moe_dispatch_impl: str = "auto"  # "auto" | "dense" | "sorted"
+    moe_normalize_gates: bool = False
 
     @property
     def moe(self):
@@ -92,6 +94,8 @@ class GPTConfig:
             capacity_factor=self.moe_capacity_factor,
             aux_loss_coef=self.moe_aux_coef,
             z_loss_coef=self.moe_z_coef,
+            dispatch_impl=self.moe_dispatch_impl,
+            normalize_gates=self.moe_normalize_gates,
         )
 
     def __post_init__(self):

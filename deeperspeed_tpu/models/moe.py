@@ -43,6 +43,25 @@ class MoEConfig:
     z_loss_coef: float = 1e-3
     # router computations always run in fp32 (small, numerically sensitive)
 
+    # "dense": one-hot (T, E, C) dispatch/combine einsums — O(T*E*C*D) but
+    #   pure matmuls, fastest at small E. "sorted": sort assignments by
+    #   expert and build the (E, C, D) buffers with gather/scatter-add —
+    #   O(T*k*(log(T*k) + D)), independent of E, the scalable path for
+    #   E >= ~16. "auto" picks by num_experts. Both produce identical
+    #   buffers (same drop order), so they are loss-equivalent.
+    dispatch_impl: str = "auto"  # "auto" | "dense" | "sorted"
+
+    # Combine weights default to RAW softmax probabilities (Switch-style:
+    # the mass of unselected experts damps the MoE branch, the residual
+    # stream carries the rest). Set True for GShard/Mixtral convention:
+    # renormalize the chosen top-k gates to sum to 1.
+    normalize_gates: bool = False
+
+    def resolved_dispatch_impl(self) -> str:
+        if self.dispatch_impl != "auto":
+            return self.dispatch_impl
+        return "sorted" if self.num_experts >= 16 else "dense"
+
 
 def init_moe_params(rng, d_model: int, d_ff: int, cfg: MoEConfig,
                     out_std: Optional[float] = None):
@@ -81,7 +100,8 @@ def _constrain(x, mesh, spec):
     return _shard_act(x, mesh, spec)
 
 
-def top_k_gating(logits, top_k: int, capacity: int):
+def top_k_gating(logits, top_k: int, capacity: int,
+                 normalize_gates: bool = False):
     """GShard-style dense routing tensors from router logits.
 
     logits: (T, E) fp32. Returns (dispatch (T, E, C) bool-ish fp32,
@@ -90,7 +110,12 @@ def top_k_gating(logits, top_k: int, capacity: int):
     Position of a token inside its expert's buffer = its rank among the
     tokens that chose that expert (cumsum over the token dim); tokens past
     capacity are dropped (their combine weight is 0 — the residual stream
-    carries them, the standard Switch behavior)."""
+    carries them, the standard Switch behavior).
+
+    Combine weights are RAW softmax probabilities by default (Switch
+    convention — see MoEConfig.normalize_gates); ``normalize_gates=True``
+    renormalizes each token's chosen top-k gates to sum to 1
+    (GShard/Mixtral convention)."""
     T, E = logits.shape
     probs = jax.nn.softmax(logits, axis=-1)  # (T, E)
 
@@ -106,7 +131,9 @@ def top_k_gating(logits, top_k: int, capacity: int):
     pos = pos_kt.reshape(top_k, T, E).transpose(1, 0, 2)  # (T, k, E)
 
     keep = (pos < capacity).astype(jnp.float32) * mask  # (T, k, E)
-    gate = probs[:, None, :] * keep  # (T, k, E) gate value where kept
+    gate = jnp.take_along_axis(probs, expert_idx, axis=1)  # (T, k)
+    if normalize_gates:
+        gate = gate / (jnp.sum(gate, axis=1, keepdims=True) + 1e-9)
 
     # scatter the k choices into (T, E, C)
     pos_c = jax.nn.one_hot(
@@ -114,8 +141,7 @@ def top_k_gating(logits, top_k: int, capacity: int):
         dtype=jnp.float32,
     )  # (T, k, C)
     dispatch = jnp.einsum("tke,tkc->tec", keep, pos_c)
-    combine = jnp.einsum("tke,tkc,tk->tec", keep, pos_c,
-                         jnp.sum(gate, axis=-1))
+    combine = jnp.einsum("tke,tkc,tk->tec", keep, pos_c, gate)
 
     # Switch aux loss ingredients (computed on the FULL router distribution)
     me = jnp.mean(probs, axis=0)  # mean router prob per expert
@@ -127,6 +153,31 @@ def top_k_gating(logits, top_k: int, capacity: int):
         "dropped_frac": 1.0 - jnp.sum(keep) / (T * top_k),
     }
     return dispatch, combine, aux
+
+
+def sorted_assignments(expert_idx, capacity: int, num_experts: int):
+    """Sort (token, choice) assignments by expert; rank within each expert.
+
+    expert_idx: (T, k) int. Returns (order, tid, expert, pos, keep) — all
+    (k*T,) arrays in sorted-by-expert order: the originating token id, the
+    expert id, the rank of the assignment inside that expert's buffer, and
+    whether it fits under ``capacity``.
+
+    Assignments are flattened CHOICE-major (all tokens' choice 0, then
+    choice 1, ...) before the stable sort, so ranks — and therefore which
+    assignments overflow — match the dense path's cumsum order exactly:
+    every token's primary choice outranks any token's secondary choice.
+    """
+    T, k = expert_idx.shape
+    e_flat = expert_idx.T.reshape(-1)  # (k*T,) choice-major
+    tid_flat = jnp.tile(jnp.arange(T, dtype=jnp.int32), k)
+    order = jnp.argsort(e_flat, stable=True)
+    e_s = e_flat[order]
+    tid_s = tid_flat[order]
+    starts = jnp.searchsorted(e_s, jnp.arange(num_experts))  # (E,)
+    pos_s = jnp.arange(k * T, dtype=jnp.int32) - starts[e_s].astype(jnp.int32)
+    keep_s = pos_s < capacity
+    return order, tid_s, e_s, pos_s, keep_s
 
 
 def load_balancing_loss(mean_prob, top1_frac, num_experts: int):
@@ -157,11 +208,35 @@ def moe_ffn(params, x, cfg: MoEConfig, mesh=None, activation=None):
     # k*T assignments spread over E buffers (GShard convention: capacity
     # scales with top_k, else top-2 structurally drops second choices)
     capacity = max(1, math.ceil(k * T / E * cfg.capacity_factor))
-    dispatch, combine, gaux = top_k_gating(logits, k, capacity)
+    impl = cfg.resolved_dispatch_impl()
 
-    # tokens -> expert buffers (XLA lowers the einsum + sharding constraint
-    # to an all-to-all over the 'expert' axis when experts are sharded)
-    expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), xt)
+    if impl == "sorted":
+        probs = jax.nn.softmax(logits, axis=-1)  # (T, E)
+        _, expert_idx = jax.lax.top_k(probs, k)  # (T, k)
+        gate = jnp.take_along_axis(probs, expert_idx, axis=1)  # (T, k)
+        if cfg.normalize_gates:
+            gate = gate / (jnp.sum(gate, axis=1, keepdims=True) + 1e-9)
+        order, tid_s, e_s, pos_s, keep_s = sorted_assignments(
+            expert_idx, capacity, E)
+        gate_s = gate.T.reshape(-1)[order]  # choice-major, sorted
+        slot_s = e_s * capacity + jnp.minimum(pos_s, capacity - 1)
+        contrib = xt[tid_s] * keep_s.astype(x.dtype)[:, None]  # (k*T, D)
+        expert_in = jnp.zeros((E * capacity, D), x.dtype).at[slot_s].add(
+            contrib).reshape(E, capacity, D)
+        gaux = {
+            "mean_prob": jnp.mean(probs, axis=0),
+            "top1_frac": jnp.zeros(E, jnp.float32)
+                           .at[expert_idx[:, 0]].add(1.0) / T,
+            "dropped_frac": 1.0 - jnp.sum(keep_s) / (T * k),
+        }
+        combine = None
+    else:
+        dispatch, combine, gaux = top_k_gating(
+            logits, k, capacity, normalize_gates=cfg.normalize_gates)
+        # tokens -> expert buffers (XLA lowers the einsum + sharding
+        # constraint to an all-to-all over the 'expert' axis when experts
+        # are sharded)
+        expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), xt)
     expert_in = _constrain(expert_in, mesh, P(EXPERT_AXIS, None, None))
 
     wi = params["experts"]["wi"].astype(x.dtype)
@@ -175,7 +250,12 @@ def moe_ffn(params, x, cfg: MoEConfig, mesh=None, activation=None):
     eo = _constrain(eo, mesh, P(EXPERT_AXIS, None, None))
 
     # expert buffers -> tokens
-    yt = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), eo)
+    if impl == "sorted":
+        eo_flat = eo.reshape(E * capacity, D)
+        w_s = (gate_s * keep_s).astype(x.dtype)[:, None]
+        yt = jnp.zeros((T, D), x.dtype).at[tid_s].add(eo_flat[slot_s] * w_s)
+    else:
+        yt = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), eo)
     y = yt.reshape(B, S, D)
     y = _constrain(y, mesh, P(DATA_AXIS, SEQ_AXIS, None))
 
