@@ -100,6 +100,19 @@ def _constrain(x, mesh, spec):
     return _shard_act(x, mesh, spec)
 
 
+def router_topk(logits, top_k: int, normalize_gates: bool = False):
+    """Shared routing decision: (probs (T,E), expert_idx (T,k), gate (T,k)).
+
+    gate values are the chosen experts' softmax probabilities (raw Switch
+    convention), optionally renormalized over the kept top-k
+    (GShard/Mixtral). Both dispatch impls consume exactly this."""
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate, expert_idx = jax.lax.top_k(probs, top_k)  # values ARE the gates
+    if normalize_gates:
+        gate = gate / (jnp.sum(gate, axis=1, keepdims=True) + 1e-9)
+    return probs, expert_idx, gate
+
+
 def top_k_gating(logits, top_k: int, capacity: int,
                  normalize_gates: bool = False):
     """GShard-style dense routing tensors from router logits.
@@ -117,10 +130,7 @@ def top_k_gating(logits, top_k: int, capacity: int,
     renormalizes each token's chosen top-k gates to sum to 1
     (GShard/Mixtral convention)."""
     T, E = logits.shape
-    probs = jax.nn.softmax(logits, axis=-1)  # (T, E)
-
-    # top-k expert choices per token
-    _, expert_idx = jax.lax.top_k(probs, top_k)  # (T, k)
+    probs, expert_idx, gate = router_topk(logits, top_k, normalize_gates)
     mask = jax.nn.one_hot(expert_idx, E, dtype=jnp.float32)  # (T, k, E)
 
     # buffer positions: rank each (token, choice) among all assignments to
@@ -131,9 +141,6 @@ def top_k_gating(logits, top_k: int, capacity: int,
     pos = pos_kt.reshape(top_k, T, E).transpose(1, 0, 2)  # (T, k, E)
 
     keep = (pos < capacity).astype(jnp.float32) * mask  # (T, k, E)
-    gate = jnp.take_along_axis(probs, expert_idx, axis=1)  # (T, k)
-    if normalize_gates:
-        gate = gate / (jnp.sum(gate, axis=1, keepdims=True) + 1e-9)
 
     # scatter the k choices into (T, E, C)
     pos_c = jax.nn.one_hot(
@@ -211,11 +218,7 @@ def moe_ffn(params, x, cfg: MoEConfig, mesh=None, activation=None):
     impl = cfg.resolved_dispatch_impl()
 
     if impl == "sorted":
-        probs = jax.nn.softmax(logits, axis=-1)  # (T, E)
-        _, expert_idx = jax.lax.top_k(probs, k)  # (T, k)
-        gate = jnp.take_along_axis(probs, expert_idx, axis=1)  # (T, k)
-        if cfg.normalize_gates:
-            gate = gate / (jnp.sum(gate, axis=1, keepdims=True) + 1e-9)
+        probs, expert_idx, gate = router_topk(logits, k, cfg.normalize_gates)
         order, tid_s, e_s, pos_s, keep_s = sorted_assignments(
             expert_idx, capacity, E)
         gate_s = gate.T.reshape(-1)[order]  # choice-major, sorted

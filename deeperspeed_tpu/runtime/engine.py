@@ -215,12 +215,6 @@ class Engine:
         self._offload = None
         off_cfg = config.zero_config.offload_optimizer
         if off_cfg.enabled:
-            if jax.process_count() > 1:
-                raise NotImplementedError(
-                    "offload_optimizer is single-process for now: the host "
-                    "step fetches globally-sharded grads, which is not "
-                    "addressable across processes yet"
-                )
             if not isinstance(self.optimizer, DeepSpeedCPUAdam):
                 # host steps always run on the cpu_adam kernel, whatever the
                 # configured optimizer name (reference forces DeepSpeedCPUAdam
@@ -351,11 +345,14 @@ class Engine:
         params_c = place(params, self.param_specs, self._compute_dtype)
 
         if getattr(self, "_offload_cfg", None) is not None:
-            # master + moments live off-device; device state is params-only
+            # master + moments live off-device; device state is params-only.
+            # The offload optimizer keys its host chunks off the ADDRESSABLE
+            # shards of the master-sharded placement, so each process owns
+            # exactly its 1/dp slice (ZeRO-Infinity per-rank swapping).
             from .offload.offload_optimizer import HostOffloadOptimizer
 
             self._offload = HostOffloadOptimizer(
-                params,
+                place(params, self.master_specs, jnp.float32),
                 self.optimizer,
                 device=self._offload_cfg.device,
                 compute_dtype=np.dtype(self._compute_dtype),
@@ -693,7 +690,8 @@ class Engine:
 
     def _offload_grads_fn(self):
         """Device half of the offloaded step: grads unscaled + clipped on
-        device (cheap, sharded), fetched once by the host Adam."""
+        device, constrained to the MASTER sharding (reduce-scattered under
+        ZeRO>=1) so each process fetches only its addressable shards."""
 
         def build():
             gas = self.gradient_accumulation_steps()
@@ -704,6 +702,9 @@ class Engine:
                 loss, grads = self._batch_grads(state, batch, rng, gas)
                 grads, gnorm, finite = self._postprocess_grads(
                     state, grads, jnp.float32(gas), clip
+                )
+                grads = partition.constrain(
+                    grads, self.master_specs, self.mesh
                 )
                 return loss, grads, gnorm, finite
 
@@ -746,29 +747,61 @@ class Engine:
             clip = float(self._config.gradient_clipping or 0.0)
 
             def fn(state, grads, gas):
-                return self._postprocess_grads(state, grads, gas, clip)
+                grads, gnorm, finite = self._postprocess_grads(
+                    state, grads, gas, clip
+                )
+                grads = partition.constrain(
+                    grads, self.master_specs, self.mesh
+                )
+                return grads, gnorm, finite
 
             return jax.jit(fn)
 
         return self._get_compiled("offload_post", build)
 
+    def _offload_reshard_fn(self):
+        """jitted identity: master-sharded compute-dtype params -> the param
+        sharding (the ZeRO all-gather, compiled; multi-process safe)."""
+
+        def build():
+            shardings = jax.tree.map(
+                lambda s: NamedSharding(self.mesh, s), self.param_specs
+            )
+            cdt = self._compute_dtype
+
+            def fn(t):
+                return jax.tree.map(lambda x: x.astype(cdt), t)
+
+            return jax.jit(fn, out_shardings=shardings)
+
+        return self._get_compiled("offload_reshard", build)
+
+    def _to_master_sharded(self, params):
+        """jitted identity: any params placement -> fp32 master sharding
+        (scatter each process its chunks)."""
+
+        def build():
+            shardings = jax.tree.map(
+                lambda s: NamedSharding(self.mesh, s), self.master_specs
+            )
+
+            def fn(t):
+                return jax.tree.map(lambda x: x.astype(jnp.float32), t)
+
+            return jax.jit(fn, out_shardings=shardings)
+
+        return self._get_compiled("offload_to_master", build)(params)
+
     def _offload_apply(self, grads_device, gnorm, finite, loss):
-        """Host half of the offloaded step: CPU Adam on host state + one
-        device_put of the fresh params."""
+        """Host half of the offloaded step: per-shard CPU Adam on this
+        process's chunks + reassembly/all-gather of the fresh params."""
         overflow = not bool(jax.device_get(finite))
         state = self.state
         if overflow:
             state = state._replace(skipped=state.skipped + 1)
         else:
-            grads_np = jax.device_get(grads_device)
-            new_params_np = self._offload.step(grads_np, lr=self._current_lr())
-            params = jax.tree.map(
-                lambda x, s: jax.device_put(
-                    np.asarray(x), NamedSharding(self.mesh, s)
-                ),
-                new_params_np,
-                self.param_specs,
-            )
+            params_m = self._offload.step(grads_device, lr=self._current_lr())
+            params = self._offload_reshard_fn()(params_m)
             state = state._replace(params=params, step=state.step + 1)
         metrics = {
             "overflow": jnp.asarray(overflow),
@@ -1178,6 +1211,18 @@ class Engine:
             # process 0 writes. The scalable alternative is
             # checkpoint.sharded_io (orbax per-shard parallel write).
             state = self._fully_replicate(state)
+            if self._offload is not None and jax.process_index() != 0:
+                # under offload each process is the ONLY holder of its master
+                # shards/moments: persist them per-rank (the analog of the
+                # reference's per-dp-rank zero_pp_rank_* optimizer files)
+                ck.save(
+                    optim_state_filename(jax.process_index()),
+                    {
+                        "offload": self._offload.state_dict(),
+                        "step": int(jax.device_get(state.step)),
+                        "zero_stage": self.zero_stage,
+                    },
+                )
             if jax.process_index() != 0:
                 return True
         model_states = {
@@ -1282,7 +1327,7 @@ class Engine:
             # sharded checkpoints carry no host/NVMe optimizer state; push
             # the restored params into the offload master so the next step
             # does not revert them (moments restart — warn loudly)
-            self._offload.set_master_params(params)
+            self._offload.set_master_params(self._to_master_sharded(params))
             logger.warning(
                 "sharded checkpoint loaded into an offload engine: params "
                 "restored, optimizer moments reset (sharded_io saves no "
@@ -1424,18 +1469,24 @@ class Engine:
             optim_state_filename()
         ):
             optim_states = ck.load(optim_state_filename())
-            if self._offload is not None and optim_states.get("offload"):
-                self._offload.load_state_dict(optim_states["offload"])
+            off_sd = optim_states.get("offload")
+            if (self._offload is not None and jax.process_count() > 1
+                    and jax.process_index() != 0):
+                # per-rank offload files (see save_checkpoint)
+                rank_file = optim_state_filename(jax.process_index())
+                off_sd = (ck.load(rank_file).get("offload")
+                          if ck.exists(rank_file) else None)
+                if off_sd is None:
+                    logger.warning(
+                        "no per-rank offload state %s in checkpoint; this "
+                        "rank's optimizer moments reset", rank_file
+                    )
+            if self._offload is not None and off_sd:
+                self._offload.load_state_dict(off_sd)
                 # refresh device params from the restored master copy
                 fresh = self._offload.current_params()
                 state = state._replace(
-                    params=jax.tree.map(
-                        lambda x, s: jax.device_put(
-                            np.asarray(x), NamedSharding(mesh, s)
-                        ),
-                        fresh,
-                        self.param_specs,
-                    ),
+                    params=self._offload_reshard_fn()(fresh),
                     step=jnp.asarray(optim_states["step"], jnp.int32),
                 )
             elif state.master is not None and optim_states.get("master"):

@@ -5,17 +5,26 @@ Capability parity with the reference's two offload tiers:
     the step runs on the AVX cpu_adam kernel with fused low-precision
     copy-back (reference runtime/zero/stage2.py:132-136,1450-1461 +
     csrc/adam/cpu_adam.cpp);
-  * ``device: nvme`` — master + moments live in per-leaf swap files and are
-    streamed through the aio op around each leaf's step, optionally
+  * ``device: nvme`` — master + moments live in per-chunk swap files and are
+    streamed through the aio op around each chunk's step, optionally
     double-buffered (reference runtime/swap_tensor/partitioned_optimizer_
     swapper.py:27, pipelined_optimizer_swapper.py:60).
 
+Sharded by construction (ZeRO-Infinity semantics): host state is keyed by
+the ADDRESSABLE SHARDS of the master-sharded device arrays, one chunk per
+unique shard index. Each process therefore holds, steps, and swaps only its
+own 1/dp of the optimizer state — the per-rank partitioned swapping of the
+reference (stage3.py:916) — and the same code runs single-process (all
+shards addressable) and multi-process (each process sees only its slice).
+
 The TPU redesign: instead of backward hooks copying grad buckets to pinned
-memory, the jitted step produces the full (unscaled, clipped) grad pytree;
-the engine fetches it once per optimizer step, this class updates host state
-and returns the bf16 (or fp32) param pytree for a single device_put. TPU
-compute overlaps the *next* step's forward; within the step, NVMe reads/
-writes overlap the per-leaf CPU Adam via the pipelined swapper.
+memory, the jitted step produces the (unscaled, clipped) grad pytree
+constrained to the master sharding (reduce-scattered under ZeRO>=2); each
+process fetches only its addressable grad shards, the CPU Adam updates the
+matching host chunks, and the fresh param shards are device_put back and
+reassembled into global arrays (jax.make_array_from_single_device_arrays).
+TPU compute overlaps the *next* step's forward; within the step, NVMe
+reads/writes overlap the per-chunk CPU Adam via the pipelined swapper.
 """
 
 from __future__ import annotations
@@ -45,13 +54,19 @@ def _leaf_name(path) -> str:
     return "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
 
 
+def _index_key(index) -> str:
+    """Stable identifier for a shard's position: the slice starts."""
+    return "-".join(str(sl.start or 0) for sl in index)
+
+
 class HostOffloadOptimizer:
-    """Owns the fp32 master copy + Adam moments off-device and performs the
-    optimizer step on the host CPU."""
+    """Owns the fp32 master copy + Adam moments off-device — one chunk per
+    addressable master shard — and performs the optimizer step on the host
+    CPU."""
 
     def __init__(
         self,
-        params,  # device (or host) pytree giving shapes/structure
+        master_params,  # pytree of jax Arrays placed with the MASTER sharding
         opt: DeepSpeedCPUAdam,
         device: str = "cpu",
         compute_dtype=np.float32,
@@ -67,17 +82,37 @@ class HostOffloadOptimizer:
         # native fused copy-back emits bf16; other dtypes cast from master
         self._bf16 = _BF16 is not None and self.out_dtype == _BF16
 
-        paths_leaves, self.treedef = jax.tree_util.tree_flatten_with_path(params)
-        self.names: List[str] = [_leaf_name(p) for p, _ in paths_leaves]
+        paths_leaves, self.treedef = jax.tree_util.tree_flatten_with_path(
+            master_params)
+        self.leaf_names: List[str] = [_leaf_name(p) for p, _ in paths_leaves]
         self.shapes = [tuple(l.shape) for _, l in paths_leaves]
-
-        host_leaves = [np.asarray(jax.device_get(l), np.float32) for _, l in paths_leaves]
+        self.shardings = [l.sharding for _, l in paths_leaves]
+        # per leaf: index_key -> shard shape, plus the full addressable
+        # placement (index_key, device) incl. replicas, for reassembly
+        self.chunk_shapes: List[Dict[str, tuple]] = []
+        self.placements: List[List[tuple]] = []
+        self.chunk_names: List[str] = []
+        chunk_data: List[np.ndarray] = []
+        for name, (_, leaf) in zip(self.leaf_names, paths_leaves):
+            shapes: Dict[str, tuple] = {}
+            placement = []
+            uniq: Dict[str, np.ndarray] = {}
+            for sh in leaf.addressable_shards:
+                key = _index_key(sh.index)
+                placement.append((key, sh.device))
+                if key not in shapes:
+                    shapes[key] = tuple(sh.data.shape)
+                    uniq[key] = np.asarray(sh.data, np.float32).ravel()
+            self.chunk_shapes.append(shapes)
+            self.placements.append(placement)
+            for key in sorted(uniq):
+                self.chunk_names.append(f"{name}@{key}")
+                chunk_data.append(uniq[key])
 
         self.swapper = None
         self._ram: Dict[str, Dict[str, np.ndarray]] = {}
         if device == "cpu":
-            for name, leaf in zip(self.names, host_leaves):
-                flat = leaf.ravel()
+            for cname, flat in zip(self.chunk_names, chunk_data):
                 states = {
                     "master": aligned_empty(flat.shape, np.float32),
                     "exp_avg": aligned_empty(flat.shape, np.float32),
@@ -86,58 +121,83 @@ class HostOffloadOptimizer:
                 np.copyto(states["master"], flat)
                 states["exp_avg"][:] = 0
                 states["exp_avg_sq"][:] = 0
-                self._ram[name] = states
+                self._ram[cname] = states
         else:
             aio_config = aio_config or AioConfig()
             swap_folder = swap_folder or os.path.join(
                 tempfile.gettempdir(), "ds_tpu_optimizer_swap")
-            cls = PipelinedOptimizerSwapper if pipeline else PartitionedOptimizerSwapper
+            if jax.process_count() > 1:  # per-rank swap files
+                swap_folder = os.path.join(
+                    swap_folder, f"rank{jax.process_index()}")
+            cls = (PipelinedOptimizerSwapper if pipeline
+                   else PartitionedOptimizerSwapper)
             self.swapper = cls(aio_config, swap_folder)
-            for name, leaf in zip(self.names, host_leaves):
-                flat = np.ascontiguousarray(leaf.ravel())
-                self.swapper.register_leaf(name, {
+            for cname, flat in zip(self.chunk_names, chunk_data):
+                flat = np.ascontiguousarray(flat)
+                self.swapper.register_leaf(cname, {
                     "master": flat,
                     "exp_avg": np.zeros_like(flat),
                     "exp_avg_sq": np.zeros_like(flat),
                 })
             log_dist(f"optimizer state swapped to NVMe at {swap_folder} "
-                     f"({len(self.names)} leaves)", ranks=[0])
-        del host_leaves
+                     f"({len(self.chunk_names)} shard chunks)", ranks=[0])
+        del chunk_data
 
     # ------------------------------------------------------------------ #
 
+    def _local_grad_chunks(self, grads) -> Dict[str, np.ndarray]:
+        """Fetch this process's addressable grad shards as flat fp32."""
+        grad_leaves = self.treedef.flatten_up_to(grads)
+        out: Dict[str, np.ndarray] = {}
+        for name, gleaf in zip(self.leaf_names, grad_leaves):
+            for sh in gleaf.addressable_shards:
+                key = f"{name}@{_index_key(sh.index)}"
+                if key not in out:
+                    out[key] = np.asarray(sh.data, np.float32).ravel()
+        return out
+
+    def _assemble(self, chunks: Dict[str, np.ndarray]):
+        """Per-leaf: device_put each addressable shard (incl. replicas) and
+        reassemble the global master-sharded array."""
+        leaves = []
+        for i, name in enumerate(self.leaf_names):
+            shapes = self.chunk_shapes[i]
+            datas = [chunks[f"{name}@{key}"].reshape(shapes[key])
+                     for key, _dev in self.placements[i]]
+            devs = [dev for _key, dev in self.placements[i]]
+            bufs = jax.device_put(datas, devs)  # one dispatch for all shards
+            leaves.append(jax.make_array_from_single_device_arrays(
+                self.shapes[i], self.shardings[i], bufs))
+        return self.treedef.unflatten(leaves)
+
     def step(self, grads, lr: float):
-        """One optimizer step. `grads` is a pytree of fp32 numpy arrays
-        (already unscaled + clipped on device). Returns the updated param
-        pytree as numpy arrays in the compute dtype, ready for device_put."""
+        """One optimizer step. ``grads`` is a pytree of device arrays in the
+        MASTER sharding (already unscaled + clipped on device). Each process
+        steps only its addressable chunks; returns the updated param pytree
+        as global master-sharded device arrays in the compute dtype."""
         self.step_count += 1
-        flat_grads = [np.asarray(g, np.float32).ravel()
-                      for g in self.treedef.flatten_up_to(grads)]
+        gmap = self._local_grad_chunks(grads)
         out: Dict[str, np.ndarray] = {}
 
-        index = {n: i for i, n in enumerate(self.names)}
-
-        def step_leaf(name: str, states: Dict[str, np.ndarray]):
-            i = index[name]
-            g = flat_grads[i]
+        def step_chunk(cname: str, states: Dict[str, np.ndarray]):
+            g = gmap[cname]
             bf16 = np.empty(g.shape, np.uint16) if self._bf16 else None
             self.opt.step_flat(
                 self.step_count, states["master"], g,
                 states["exp_avg"], states["exp_avg_sq"], lr=lr, bf16_out=bf16)
             if self._bf16:
-                out[name] = bf16.view(_BF16).reshape(self.shapes[i])
+                out[cname] = bf16.view(_BF16)
             elif self.out_dtype == np.float32:
-                out[name] = states["master"].reshape(self.shapes[i]).copy()
+                out[cname] = states["master"].copy()
             else:  # e.g. fp16 compute: cast from the fp32 master
-                out[name] = states["master"].reshape(self.shapes[i]).astype(
-                    self.out_dtype)
+                out[cname] = states["master"].astype(self.out_dtype)
 
         if self.device == "cpu":
-            for name in self.names:
-                step_leaf(name, self._ram[name])
+            for cname in self.chunk_names:
+                step_chunk(cname, self._ram[cname])
         else:
-            self.swapper.for_each_leaf(self.names, step_leaf)
-        return self.treedef.unflatten([out[n] for n in self.names])
+            self.swapper.for_each_leaf(self.chunk_names, step_chunk)
+        return self._assemble(out)
 
     # ------------------------------------------------------------------ #
     # checkpoint surface (consumed by Engine.save/load_checkpoint)
@@ -148,13 +208,15 @@ class HostOffloadOptimizer:
             return {n: {k: v.copy() for k, v in s.items()}
                     for n, s in self._ram.items()}
         states = {}
-        for name in self.names:
-            buf = self.swapper.swap_in(name, async_op=False)
-            states[name] = {k: v.copy()
-                            for k, v in self.swapper.unpack(name, buf).items()}
+        for cname in self.chunk_names:
+            buf = self.swapper.swap_in(cname, async_op=False)
+            states[cname] = {k: v.copy()
+                             for k, v in self.swapper.unpack(cname, buf).items()}
         return states
 
     def state_dict(self) -> dict:
+        """This PROCESS's chunk states (per-rank, like the reference's
+        mp_rank optimizer checkpoint files)."""
         return {
             "step": self.step_count,
             "states": self._all_states(),
@@ -163,42 +225,58 @@ class HostOffloadOptimizer:
 
     def load_state_dict(self, sd: dict):
         self.step_count = int(sd["step"])
-        for name in self.names:
-            src = sd["states"][name]
+        missing = [c for c in self.chunk_names if c not in sd["states"]]
+        if missing:
+            raise ValueError(
+                "offload checkpoint does not match this run's shard "
+                f"topology: {len(missing)}/{len(self.chunk_names)} chunk "
+                f"keys absent (e.g. {missing[0]!r}). Offload optimizer "
+                "state is chunked per master shard and therefore bound to "
+                "the device mesh it was saved on (like the reference's "
+                "per-rank ZeRO checkpoints); to move across topologies, "
+                "restore params via checkpoint.sharded_io (elastic "
+                "re-shard) and let the moments restart."
+            )
+        for cname in self.chunk_names:
+            src = sd["states"][cname]
             if self.device == "cpu":
                 for k in ("master", "exp_avg", "exp_avg_sq"):
-                    np.copyto(self._ram[name][k], np.asarray(src[k]))
+                    np.copyto(self._ram[cname][k], np.asarray(src[k]))
             else:
                 self.swapper.swap_out(
-                    name,
+                    cname,
                     {k: np.ascontiguousarray(np.asarray(src[k]))
                      for k in ("master", "exp_avg", "exp_avg_sq")},
                     async_op=False)
 
-    def set_master_params(self, params):
-        """Overwrite the host fp32 masters from a param pytree (checkpoint
-        restore paths where no offload state was saved; moments keep their
-        current values)."""
-        leaves = jax.tree_util.tree_leaves(params)
-        assert len(leaves) == len(self.names)
-        for name, leaf in zip(self.names, leaves):
-            flat = np.asarray(jax.device_get(leaf), np.float32).ravel()
+    def set_master_params(self, master_params):
+        """Overwrite the host fp32 masters from a MASTER-SHARDED device
+        pytree (checkpoint restore paths where no offload state was saved;
+        moments keep their current values)."""
+        fresh = self._local_grad_chunks(master_params)
+        for cname in self.chunk_names:
+            flat = fresh[cname]
             if self.device == "cpu":
-                np.copyto(self._ram[name]["master"], flat)
+                np.copyto(self._ram[cname]["master"], flat)
             else:
-                buf = self.swapper.swap_in(name, async_op=False)
+                buf = self.swapper.swap_in(cname, async_op=False)
                 states = {k: v.copy() for k, v in
-                          self.swapper.unpack(name, buf).items()}
+                          self.swapper.unpack(cname, buf).items()}
                 states["master"] = np.ascontiguousarray(flat)
-                self.swapper.swap_out(name, states, async_op=False)
+                self.swapper.swap_out(cname, states, async_op=False)
 
     def current_params(self):
-        """Materialize the compute-dtype param pytree from the master copy
-        (used on checkpoint load to refresh device params)."""
-        outs = []
+        """Materialize the compute-dtype param pytree (global master-sharded
+        device arrays) from the master copy (used on checkpoint load to
+        refresh device params)."""
         states = self._all_states() if self.device == "nvme" else self._ram
-        for i, name in enumerate(self.names):
-            m = states[name]["master"].reshape(self.shapes[i])
-            outs.append(m.copy() if self.out_dtype == np.float32
-                        else m.astype(self.out_dtype))
-        return self.treedef.unflatten(outs)
+        chunks = {}
+        for cname in self.chunk_names:
+            m = states[cname]["master"]
+            if self._bf16:
+                chunks[cname] = m.astype(_BF16)
+            elif self.out_dtype == np.float32:
+                chunks[cname] = m.copy()
+            else:
+                chunks[cname] = m.astype(self.out_dtype)
+        return self._assemble(chunks)

@@ -1,0 +1,68 @@
+"""End-to-end 2-process jax.distributed integration through the repo's own
+launcher: launch.py spawns 2 local worker processes, each rendezvouses via
+init_distributed() (Gloo-backed CPU collectives), trains dp=2 through the
+engine, and asserts loss parity with a single-device reference.
+
+This is the harness-level proof the reference gets from its multi-worker
+@distributed_test decorator (/root/reference/tests/unit/common.py:36-88):
+launcher -> rendezvous -> cross-process collectives -> optimizer parity,
+with real separate processes rather than the in-process 8-device mesh the
+rest of the suite uses.
+"""
+
+import base64
+import json
+import os
+import socket
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_launcher_train_parity(tmp_path):
+    result_file = tmp_path / "result.txt"
+    world_info = base64.urlsafe_b64encode(
+        json.dumps({"localhost": [0, 1]}).encode()
+    ).decode()
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # one CPU device per process: drop the suite's 8-device forcing flag
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = REPO
+    # silence the coordinator's distributed-service port clashes on reruns
+    port = _free_port()
+
+    cmd = [
+        sys.executable, "-m", "deeperspeed_tpu.launcher.launch",
+        "--node_rank", "0",
+        "--master_addr", "127.0.0.1",
+        "--master_port", str(port),
+        "--world_info", world_info,
+        "--procs_per_node", "2",
+        os.path.join(REPO, "tests", "dist_worker.py"),
+        str(result_file),
+    ]
+    proc = subprocess.run(
+        cmd, env=env, cwd=REPO, capture_output=True, text=True, timeout=300
+    )
+    assert proc.returncode == 0, (
+        f"launcher rc={proc.returncode}\nstdout:\n{proc.stdout[-3000:]}\n"
+        f"stderr:\n{proc.stderr[-3000:]}"
+    )
+    assert result_file.exists(), proc.stdout[-2000:] + proc.stderr[-2000:]
+    content = result_file.read_text()
+    assert content.startswith("PARITY-OK"), content
+    # training actually made progress
+    losses = [float(v) for v in content.split()[1:] if "=" not in v]
+    assert losses[-1] < losses[0] / 2, losses
+    # phase 2 proof: each rank held only a fraction of the master state
+    frac = float(content.split("offload_local_frac=")[1])
+    assert frac < 0.9, content

@@ -357,6 +357,17 @@ class TestOffloadedEngine:
         np.testing.assert_allclose(
             float(off.train_batch(b)), float(fresh.train_batch(b)), rtol=1e-6)
 
+    def test_host_state_is_per_shard_chunks(self):
+        """ZeRO-Infinity semantics: host chunks follow the master sharding
+        (one chunk per unique addressable shard — 8 per sharded leaf on the
+        8-device mesh), covering each element exactly once."""
+        off = _make_engine(offload_device="cpu")
+        leaves = jax.tree.leaves(off.state.params)
+        total = sum(l.size for l in leaves)
+        held = sum(s["master"].size for s in off._offload._ram.values())
+        assert held == total, (held, total)
+        assert len(off._offload.chunk_names) > len(leaves)
+
     def test_ds_report_lists_native_ops(self, capsys):
         for name, builder in ALL_OPS.items():
             assert isinstance(builder.compatibility_message(), str)
