@@ -62,6 +62,16 @@ class FusedAdam:
         self.state_dtype_sq = (
             state_dtype if (1.0 - self.betas[1]) >= 2.0 ** -7 else jnp.float32
         )
+        if self.state_dtype_sq != jnp.dtype(state_dtype):
+            from ..utils.logging import logger
+
+            logger.warning(
+                "FusedAdam: exp_avg_sq kept in fp32 despite state_dtype=%s "
+                "— 1-beta2=%.2e is below 2^-7, where bf16 second moments "
+                "round updates to zero. Budget +4 bytes/param of optimizer "
+                "state, or use beta2 <= 0.992 (e.g. 0.95) for bf16 moments.",
+                jnp.dtype(state_dtype).name, 1.0 - self.betas[1],
+            )
 
     def init(self, params) -> AdamState:
         return AdamState(

@@ -104,7 +104,18 @@ def is_available(q) -> bool:
     # (no multiple-of-128 divisor in [128, default]) must fall back to XLA
     bq = _auto_block(S, DEFAULT_BLOCK_Q)
     bk = _auto_block(S, DEFAULT_BLOCK_K)
-    return bq * bk * 4 <= 8 * 1024 * 1024
+    if bq * bk * 4 > 8 * 1024 * 1024:
+        return False
+    # full-sequence residency: the fwd/dQ kernels pin whole-S K and V in
+    # VMEM and the dK/dV kernel pins whole-S Q and dO, so at large S the
+    # dominant tile is 2 * S * Dh in the input dtype. Budget it against
+    # ~2/3 of a v5e core's 16MB VMEM (leaving room for the scores tile,
+    # accumulators, and double-buffering); past that, ring/sparse/XLA
+    # attention take over.
+    itemsize = q.dtype.itemsize if hasattr(q, "dtype") else 2
+    if 2 * S * Dh * itemsize > 10 * 1024 * 1024:
+        return False
+    return True
 
 
 # ------------------------------------------------------------------ #

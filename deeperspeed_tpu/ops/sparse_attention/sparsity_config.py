@@ -31,7 +31,7 @@ class SparsityConfig:
     def setup_layout(self, seq_len: int) -> np.ndarray:
         if seq_len % self.block != 0:
             raise ValueError(
-                f"Sequence Length, {seq_len}, needs to be dividable by "
+                f"Sequence Length, {seq_len}, needs to be divisible by "
                 f"Block size {self.block}!"
             )
         num_blocks = seq_len // self.block
@@ -63,7 +63,7 @@ def _sliding_window(layout, h, num_window_blocks, bidirectional):
     if nb < num_window_blocks:
         raise ValueError(
             f"Number of sliding window blocks, {num_window_blocks}, must be "
-            f"smaller than overal number of blocks in a row, {nb}!"
+            f"smaller than overall number of blocks in a row, {nb}!"
         )
     w = num_window_blocks // 2
     rows = np.arange(nb)[:, None]
@@ -100,7 +100,7 @@ class FixedSparsityConfig(SparsityConfig):
         if num_global_blocks > 0 and num_local_blocks % num_global_blocks != 0:
             raise ValueError(
                 f"Number of blocks in a local window, {num_local_blocks}, "
-                f"must be dividable by number of global blocks, "
+                f"must be divisible by number of global blocks, "
                 f"{num_global_blocks}!"
             )
         self.num_global_blocks = num_global_blocks
@@ -221,7 +221,7 @@ class VariableSparsityConfig(SparsityConfig):
         if nb < self.num_random_blocks:
             raise ValueError(
                 f"Number of random blocks, {self.num_random_blocks}, must be "
-                f"smaller than overal number of blocks in a row, {nb}!"
+                f"smaller than overall number of blocks in a row, {nb}!"
             )
         for row in range(nb):
             cols = self._rng.choice(nb, self.num_random_blocks, replace=False)
@@ -289,7 +289,7 @@ class BigBirdSparsityConfig(SparsityConfig):
         if nb < self.num_random_blocks:
             raise ValueError(
                 f"Number of random blocks, {self.num_random_blocks}, must be "
-                f"smaller than overal number of blocks in a row, {nb}!"
+                f"smaller than overall number of blocks in a row, {nb}!"
             )
         for row in range(nb):
             hi = nb if self.attention == "bidirectional" else row + 1
@@ -307,7 +307,7 @@ class BigBirdSparsityConfig(SparsityConfig):
         if nb < self.num_global_blocks:
             raise ValueError(
                 f"Number of global blocks, {self.num_global_blocks}, must be "
-                f"smaller than overal number of blocks in a row, {nb}!"
+                f"smaller than overall number of blocks in a row, {nb}!"
             )
         layout[h, : self.num_global_blocks, :] = 1
         layout[h, :, : self.num_global_blocks] = 1

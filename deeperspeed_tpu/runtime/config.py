@@ -148,6 +148,18 @@ class TrainingConfig:
             )
         # fp32 never uses a master copy; the flag is simply moot there
 
+        self.grad_accum_dtype = bf16_dict.get(
+            c.BFLOAT16_GRAD_ACCUM_DTYPE,
+            fp16_dict.get(c.BFLOAT16_GRAD_ACCUM_DTYPE,
+                          c.BFLOAT16_GRAD_ACCUM_DTYPE_DEFAULT)
+        )
+        if self.grad_accum_dtype not in (None, "fp32", "float32",
+                                         "bf16", "bfloat16"):
+            raise ValueError(
+                f"grad_accum_dtype must be fp32/bf16/None, got "
+                f"{self.grad_accum_dtype!r}"
+            )
+
         self.loss_scale = fp16_dict.get(c.FP16_LOSS_SCALE, c.FP16_LOSS_SCALE_DEFAULT)
         self.initial_scale_power = fp16_dict.get(
             c.FP16_INITIAL_SCALE_POWER, c.FP16_INITIAL_SCALE_POWER_DEFAULT

@@ -57,6 +57,14 @@ BFLOAT16_ENABLED_DEFAULT = False
 # per chip (how a 1.3B model trains on one 16GB chip without offload)
 BFLOAT16_MASTER_WEIGHTS = "master_weights"
 BFLOAT16_MASTER_WEIGHTS_DEFAULT = True
+# dtype of the gradient-accumulation carry across gradient_accumulation_
+# steps microbatches. Default (None) follows the grad storage dtype — bf16
+# in masterless mode, where at high gas small per-microbatch contributions
+# can round away against the growing accumulator. "fp32" accumulates in
+# fp32 (+2 bytes/param transient) and casts back to the grad dtype after
+# the scan.
+BFLOAT16_GRAD_ACCUM_DTYPE = "grad_accum_dtype"
+BFLOAT16_GRAD_ACCUM_DTYPE_DEFAULT = None
 
 FP16_LOSS_SCALE = "loss_scale"
 FP16_LOSS_SCALE_DEFAULT = 0  # 0 => dynamic

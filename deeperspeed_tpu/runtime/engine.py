@@ -162,6 +162,14 @@ class Engine:
         self._grad_dtype = (jnp.float32 if (self._use_master
                             or self._compute_dtype == jnp.float32)
                             else self._compute_dtype)
+        # accumulation carry across gas microbatches (see constants.py:
+        # BFLOAT16_GRAD_ACCUM_DTYPE); None follows the grad storage dtype
+        gad = config.grad_accum_dtype
+        self._grad_accum_dtype = (
+            jnp.float32 if gad in ("fp32", "float32")
+            else jnp.bfloat16 if gad in ("bf16", "bfloat16")
+            else self._grad_dtype
+        )
         self.zero_stage = config.zero_optimization_stage
 
         self.timers = SynchronizedWallClockTimer()
@@ -650,7 +658,7 @@ class Engine:
 
         batch_g = jax.tree.map(resh, batch)
         zero_g = jax.tree.map(
-            lambda p: jnp.zeros(p.shape, self._grad_dtype), state.params
+            lambda p: jnp.zeros(p.shape, self._grad_accum_dtype), state.params
         )
         zero_g = partition.constrain(zero_g, self.grad_specs, self.mesh)
 
@@ -662,12 +670,15 @@ class Engine:
                 state.params, mb, jax.random.fold_in(rng, i), scale
             )
             grads = partition.constrain(grads, self.grad_specs, self.mesh)
-            acc = jax.tree.map(jnp.add, acc, grads)
+            acc = jax.tree.map(lambda a, g: a + g.astype(a.dtype), acc, grads)
             acc = partition.constrain(acc, self.grad_specs, self.mesh)
             return (acc, loss_sum + loss, i + 1), None
 
         (grads, loss_sum, _), _ = jax.lax.scan(
             body, (zero_g, jnp.float32(0.0), jnp.int32(0)), batch_g
+        )
+        grads = jax.tree.map(
+            lambda g: g.astype(self._grad_dtype), grads
         )
         return loss_sum / gas, grads
 
@@ -895,9 +906,15 @@ class Engine:
         self._last_micro_loss = stashed_loss  # for step()-path monitoring
         self._stashed = None
         if self._grad_acc is None:
-            self._grad_acc = grads
+            # bank the carry in the configured accumulation dtype (see
+            # grad_accum_dtype) so the imperative path matches train_batch
+            self._grad_acc = jax.tree.map(
+                lambda g: g.astype(self._grad_accum_dtype), grads
+            )
         else:
-            self._grad_acc = jax.tree.map(jnp.add, self._grad_acc, grads)
+            self._grad_acc = jax.tree.map(
+                lambda a, g: a + g.astype(a.dtype), self._grad_acc, grads
+            )
         self._acc_count += 1
         return loss
 
@@ -911,16 +928,21 @@ class Engine:
             self._timer_start(STEP_MICRO_TIMER)
         gas = self.gradient_accumulation_steps()
         if self._acc_count >= gas:
+            # hand the optimizer grads in the storage dtype (the fused path
+            # casts its scan carry back the same way)
+            banked = jax.tree.map(
+                lambda g: g.astype(self._grad_dtype), self._grad_acc
+            )
             if self._offload is not None:
                 grads, gnorm, finite = self._offload_post_fn()(
-                    self.state, self._grad_acc, np.float32(self._acc_count)
+                    self.state, banked, np.float32(self._acc_count)
                 )
                 metrics = self._offload_apply(grads, gnorm, finite, None)
             else:
                 lr = np.float32(self._current_lr())
                 # the imperative path banked unscaled-by-gas grads; scale in fn
                 new_state, metrics = self._apply_update_fn()(
-                    self.state, self._grad_acc, lr, np.float32(self._acc_count)
+                    self.state, banked, lr, np.float32(self._acc_count)
                 )
                 self.state = new_state
             if self.store_gradients:

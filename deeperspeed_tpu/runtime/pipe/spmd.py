@@ -64,8 +64,13 @@ def _opt_specs_like(opt_state, params, p_spec):
     pt = jax.tree.structure(params)
     flat_specs = jax.tree.leaves(p_spec, is_leaf=lambda x: isinstance(x, P))
     shape_of = {}
+    ambiguous = set()
     for pleaf, sp in zip(jax.tree.leaves(params), flat_specs):
-        shape_of.setdefault(pleaf.shape, sp)
+        prev = shape_of.setdefault(pleaf.shape, sp)
+        if prev != sp:
+            # two differently-sharded params share this shape: a loose
+            # optimizer-state leaf of this shape cannot be resolved safely
+            ambiguous.add(pleaf.shape)
 
     def walk(node):
         is_container = (hasattr(node, "_fields")
@@ -81,6 +86,14 @@ def _opt_specs_like(opt_state, params, p_spec):
                     return p_spec
             except Exception:
                 pass
+            if node.shape in ambiguous:
+                raise ValueError(
+                    f"cannot infer a sharding for optimizer-state leaf of "
+                    f"shape {node.shape}: multiple params share this shape "
+                    "with different PartitionSpecs. Structure the optimizer "
+                    "state to mirror the params pytree (e.g. moments as "
+                    "params-shaped subtrees) so specs resolve by structure."
+                )
             return shape_of.get(node.shape, P(*([None] * jnp.ndim(node))))
         try:
             if jax.tree.structure(node) == pt:

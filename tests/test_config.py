@@ -175,3 +175,18 @@ def test_scheduler_and_optimizer_blocks():
     assert cfg.optimizer_name == "Adam"
     assert cfg.optimizer_params["lr"] == 0.1
     assert cfg.scheduler_name == "WarmupLR"
+
+
+def test_grad_accum_dtype_config():
+    cfg = TrainingConfig(
+        {"train_batch_size": 8,
+         "bf16": {"enabled": True, "master_weights": False,
+                  "grad_accum_dtype": "fp32"}}
+    )
+    assert cfg.grad_accum_dtype == "fp32"
+    assert TrainingConfig({"train_batch_size": 8}).grad_accum_dtype is None
+    with pytest.raises(ValueError):
+        TrainingConfig(
+            {"train_batch_size": 8,
+             "bf16": {"enabled": True, "grad_accum_dtype": "int8"}}
+        )

@@ -458,3 +458,54 @@ def test_masterless_composes_with_zero(stage):
         X = rng.normal(size=(16, 16)).astype(np.float32)
         losses.append(float(jax.device_get(eng.train_batch((X, X @ W)))))
     assert losses[-1] < losses[0] / 2, losses
+
+
+def test_masterless_bf16_fp32_grad_accumulation():
+    """bf16.grad_accum_dtype=fp32 must change what the bf16 carry rounds
+    away: accumulate one large microbatch grad (1.0) followed by seven tiny
+    ones (0.002, below bf16's ulp at 1.0) — the bf16 carry stays at 1.0,
+    the fp32 carry reaches 1.014 and rounds ONCE on the final cast."""
+    import deeperspeed_tpu as ds
+
+    def make(gad):
+        # single-leaf linear loss: dL/dw = mean over batch elements of x
+        params = {"w": jnp.zeros((4,), jnp.float32)}
+
+        def loss(p, batch):
+            return jnp.mean(p["w"] * batch)
+
+        bf16 = {"enabled": True, "master_weights": False}
+        if gad:
+            bf16["grad_accum_dtype"] = gad
+        engine, _, _, _ = ds.initialize(
+            model=loss, model_parameters=params,
+            config={"train_micro_batch_size_per_gpu": 1,
+                    "gradient_accumulation_steps": 8,
+                    "optimizer": {"type": "Adam",
+                                  "params": {"lr": 1e-2,
+                                             "betas": [0.9, 0.95]}},
+                    "bf16": bf16},
+        )
+        return engine
+
+    eng32, eng16 = make("fp32"), make(None)
+    assert eng32._grad_accum_dtype == jnp.float32
+    assert eng16._grad_accum_dtype == jnp.bfloat16
+    assert eng32._grad_dtype == jnp.bfloat16
+
+    dp = eng32.data_parallel_size
+    rows = np.full((8 * dp, 4), 0.002, np.float32)
+    rows[:dp] = 1.0  # microbatch 0 large, the rest tiny
+    batch = jnp.asarray(rows)
+
+    def accumulated(eng):
+        _, grads = eng._batch_grads(
+            eng.state, batch, jax.random.PRNGKey(0), 8)
+        return float(np.asarray(grads["w"], np.float32)[0])
+
+    g32, g16 = accumulated(eng32), accumulated(eng16)
+    # per-microbatch grad = x/4: large mb -> 0.25, tiny mbs -> 0.0005 each
+    # (below bf16's ulp/2 at 0.25). bf16 carry: every tiny add rounds back
+    # to 0.25. fp32 carry: 0.2535, rounded ONCE to bf16 on the final cast.
+    assert abs(g16 - 0.25) < 1e-7, g16
+    assert g32 > 0.2525, g32

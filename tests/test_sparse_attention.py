@@ -106,7 +106,7 @@ def test_local_sliding_window_layout():
 
 
 def test_layout_seq_not_divisible_raises():
-    with pytest.raises(ValueError, match="dividable by Block size"):
+    with pytest.raises(ValueError, match="divisible by Block size"):
         DenseSparsityConfig(num_heads=H, block=BLOCK).make_layout(60)
 
 
