@@ -58,7 +58,7 @@ class SparseSelfAttention:
     def get_layout(self, L: int) -> np.ndarray:
         if L % self.sparsity_config.block != 0:
             raise ValueError(
-                f"Sequence Length, {L}, needs to be dividable by Block size "
+                f"Sequence Length, {L}, needs to be divisible by Block size "
                 f"{self.sparsity_config.block}!"
             )
         nb = L // self.sparsity_config.block

@@ -50,6 +50,7 @@ from ..parallel.topology import DATA_AXIS, build_mesh, single_device_mesh
 from ..utils.logging import log_dist, logger
 from ..utils.timer import SynchronizedWallClockTimer, ThroughputTimer
 from . import lr_schedules
+from .accessors import ConfigAccessorsMixin, make_summary_writer
 from .config import TrainingConfig
 from .dataloader import DeepSpeedDataLoader
 from .fp16.loss_scaler import LossScaleState, create_loss_scaler
@@ -87,7 +88,7 @@ def _dtype_of(precision: str):
     }[precision]
 
 
-class Engine:
+class Engine(ConfigAccessorsMixin):
     def __init__(
         self,
         model: Callable,
@@ -182,14 +183,7 @@ class Engine:
 
         # tensorboard monitor (reference engine.py:163; writer on the first
         # process only, as the reference gates on global rank 0)
-        self.summary_writer = None
-        if getattr(config, "tensorboard_enabled", False) and jax.process_index() == 0:
-            from ..utils.tensorboard import TensorBoardMonitor
-
-            self.summary_writer = TensorBoardMonitor(
-                output_path=config.tensorboard_output_path,
-                job_name=config.tensorboard_job_name,
-            )
+        self.summary_writer = make_summary_writer(config)
 
         # fork extras (reference engine.py:139,227): gradient stashing and
         # layer-output capture
@@ -401,30 +395,12 @@ class Engine:
     # reference-API accessors
     # ------------------------------------------------------------------ #
 
-    def train_batch_size(self):
-        return self._config.train_batch_size
-
     def current_batch_size(self):
         """Scheduled effective batch size (== train_batch_size unless a
         batch_scheduler block is configured)."""
         if self.batch_size_scheduler is not None:
             return self.batch_size_scheduler.current_batch_size
         return self._config.train_batch_size
-
-    def train_micro_batch_size_per_gpu(self):
-        return self._config.train_micro_batch_size_per_gpu
-
-    def gradient_accumulation_steps(self):
-        return self._config.gradient_accumulation_steps
-
-    def gradient_clipping(self):
-        return self._config.gradient_clipping
-
-    def zero_optimization_stage(self):
-        return self.zero_stage
-
-    def get_lr(self):
-        return [self._current_lr()]
 
     def get_global_grad_norm(self):
         if self._pending_metrics is None:
@@ -448,58 +424,6 @@ class Engine:
     def is_gradient_accumulation_boundary(self):
         return (self.micro_steps + 1) % self.gradient_accumulation_steps() == 0
 
-    def get_batch_info(self):
-        """(train_batch_size, micro_batch_per_gpu, grad_accum_steps) —
-        reference engine.py:256."""
-        return (self._config.train_batch_size,
-                self._config.train_micro_batch_size_per_gpu,
-                self._config.gradient_accumulation_steps)
-
-    def set_lr(self, lr):
-        """Pin the learning rate (reference _set_optimizer_param surface:
-        sets the lr directly; an active scheduler overwrites it again at its
-        next step(), same as torch param_groups)."""
-        self._client_lr = float(lr)
-        self._lr_override = float(lr)
-
-    def get_mom(self):
-        """Momentum/betas of the active optimizer (reference engine.py:1305)."""
-        opt = self.optimizer
-        if hasattr(opt, "momentum"):
-            return [opt.momentum]
-        if hasattr(opt, "betas"):
-            return [list(opt.betas)]
-        return None
-
-    def get_pld_theta(self):
-        if self.progressive_layer_drop is not None:
-            return self.progressive_layer_drop.get_theta()
-        return None
-
-    def elasticity_enabled(self):
-        return bool(getattr(self._config, "elasticity_enabled", False))
-
-    def memory_breakdown(self):
-        return getattr(self._config, "memory_breakdown", False)
-
-    def sparse_gradients_enabled(self):
-        return getattr(self._config, "sparse_gradients_enabled", False)
-
-    def wall_clock_breakdown(self):
-        return self._config.wall_clock_breakdown
-
-    def optimizer_name(self):
-        return self._config.optimizer_name
-
-    def optimizer_params(self):
-        return self._config.optimizer_params
-
-    def scheduler_name(self):
-        return self._config.scheduler_name
-
-    def scheduler_params(self):
-        return self._config.scheduler_params
-
     def save_fp16_model(self, save_dir, save_filename="model_fp16.msgpack"):
         """Save consolidated compute-dtype weights only (reference
         engine.py:1882 — gathers ZeRO-3 shards first)."""
@@ -511,13 +435,6 @@ class Engine:
         save_tree(path, host)
         log_dist(f"saved fp16 model weights to {path}", ranks=[0])
         return path
-
-    def _current_lr(self):
-        if self._lr_override is not None:
-            return self._lr_override
-        if self.lr_scheduler is not None:
-            return float(self.lr_scheduler.get_lr())
-        return float(self._client_lr)
 
     # ------------------------------------------------------------------ #
     # data placement
@@ -946,7 +863,7 @@ class Engine:
                 )
                 self.state = new_state
             if self.store_gradients:
-                self._store_grads(self._grad_acc)
+                self._store_grads(banked)
             self._grad_acc = None
             self._acc_count = 0
             self._after_optimizer_step(metrics)

@@ -40,6 +40,7 @@ from ...utils.logging import log_dist, logger
 from ...utils.timer import SynchronizedWallClockTimer, ThroughputTimer
 from .. import lr_schedules
 from .. import utils as runtime_utils
+from ..accessors import ConfigAccessorsMixin, make_summary_writer
 from ..config import TrainingConfig
 from ..dataloader import RepeatingLoader
 from . import schedule as sched_mod
@@ -86,7 +87,7 @@ def _batch_spec(x) -> P:
     return P(DATA_AXIS, *([None] * (np.ndim(x) - 1)))
 
 
-class PipelineEngine:
+class PipelineEngine(ConfigAccessorsMixin):
     """Executes PipeSchedules over a PipelineModule (reference :52)."""
 
     def __init__(
@@ -154,6 +155,11 @@ class PipelineEngine:
         self.global_samples = 0
         self.micro_steps = 0
         self.skipped_steps = 0
+        self._lr_override = None  # set_lr pin; cleared by scheduler steps
+
+        # tensorboard monitor (same surface as Engine; reference
+        # pipe engine inherits it from DeepSpeedEngine)
+        self.summary_writer = make_summary_writer(config)
 
         self._init_stage_state()
         self._jit_cache: Dict[Any, Callable] = {}
@@ -504,6 +510,9 @@ class PipelineEngine:
         self._update_loss_scale(overflow=False)
         coef = 1.0 if clip <= 0 else min(1.0, clip / (gnorm + 1e-6))
         lr = jnp.float32(self._current_lr())
+        # the lr actually APPLIED this step — monitoring reads this, not
+        # _current_lr(), which the scheduler advances just below
+        self._last_applied_lr = float(lr)
 
         for s in range(self.num_stages):
             g = self.stage_grads[s]
@@ -531,17 +540,31 @@ class PipelineEngine:
         self.global_samples += self._config.train_batch_size
         if self.lr_scheduler is not None:
             self.lr_scheduler.step()
-
-    def _current_lr(self):
-        if self.lr_scheduler is not None:
-            return float(self.lr_scheduler.get_lr())
-        return float(self._client_lr)
-
-    def get_lr(self):
-        return [self._current_lr()]
+            self._lr_override = None
 
     def get_global_grad_norm(self):
         return getattr(self, "_last_grad_norm", 0.0)
+
+    def loss_scale(self):
+        return self.loss_scale_value
+
+    def save_fp16_model(self, save_dir, save_filename="model_fp16.msgpack"):
+        """Save consolidated compute-dtype weights only (reference
+        engine.py:1882): per-stage slices merged back into the module's
+        params dict, cast to the compute dtype."""
+        import os
+
+        from ...checkpoint.serialization import save_tree
+
+        os.makedirs(save_dir, exist_ok=True)
+        host = jax.tree.map(
+            lambda x: np.asarray(x).astype(self._compute_dtype),
+            self._gather_params_all(),
+        )
+        path = os.path.join(save_dir, save_filename)
+        save_tree(path, host)
+        log_dist(f"saved fp16 model weights to {path}", ranks=[0])
+        return path
 
     # -------------------------------------------------------------- #
     # schedule execution (reference _exec_schedule :1295)
@@ -657,6 +680,20 @@ class PipelineEngine:
         self.micro_steps += self.micro_batches
         loss = self._aggregate_total_loss()
         self.tput_timer.stop(global_step=True, sync_with=None)
+        if self.summary_writer is not None:
+            # loss is already a host float (_aggregate_total_loss fetched
+            # it), so the write adds no extra device sync; flush rides the
+            # steps_per_print cadence rather than every batch
+            scalars = {
+                "Train/Samples/lr": getattr(self, "_last_applied_lr",
+                                            self._current_lr()),
+                "Train/Samples/train_loss": float(loss),
+            }
+            if self._dyn_scaler is not None:
+                scalars["Train/Samples/loss_scale"] = self.loss_scale_value
+            self.summary_writer.write_scalars(scalars, self.global_samples)
+            if self.global_steps % self._config.steps_per_print == 0:
+                self.summary_writer.flush()
         if self._config.wall_clock_breakdown:
             # float(loss) below (or here) syncs the step, so the batch timer
             # covers dispatch + device completion
@@ -723,32 +760,6 @@ class PipelineEngine:
     # -------------------------------------------------------------- #
     # config accessors mirroring Engine
     # -------------------------------------------------------------- #
-
-    def train_batch_size(self):
-        return self._config.train_batch_size
-
-    def gradient_accumulation_steps(self):
-        return self._config.gradient_accumulation_steps
-
-    def train_micro_batch_size_per_gpu(self):
-        return self._config.train_micro_batch_size_per_gpu
-
-    def get_batch_info(self):
-        return (self._config.train_batch_size,
-                self._config.train_micro_batch_size_per_gpu,
-                self._config.gradient_accumulation_steps)
-
-    def zero_optimization_stage(self):
-        return self._config.zero_optimization_stage
-
-    def wall_clock_breakdown(self):
-        return self._config.wall_clock_breakdown
-
-    def optimizer_name(self):
-        return self._config.optimizer_name
-
-    def scheduler_name(self):
-        return self._config.scheduler_name
 
     def is_gradient_accumulation_boundary(self):
         return True
