@@ -54,10 +54,9 @@ def _lut_pallas_call(kernel, grid, in_specs, out_specs, out_shape,
         out_specs=out_specs,
         scratch_shapes=scratch_shapes,
     )
-    # batch/q-block dims reorder freely; the LUT dim accumulates into
-    # scratch and must run in order
-    kwargs = _compiler_params(interpret, 3,
-                              ("parallel", "parallel", "arbitrary"))
+    # the batch*head dim reorders freely; the flat-LUT entry dim accumulates
+    # into scratch and must run in order
+    kwargs = _compiler_params(interpret, 2, ("parallel", "arbitrary"))
     return pl.pallas_call(
         kernel, grid_spec=grid_spec, out_shape=out_shape, interpret=interpret,
         **kwargs,
@@ -98,42 +97,128 @@ def layout_density(layout: np.ndarray) -> float:
     return float(layout.mean())
 
 
+def build_flat_lut(layout: np.ndarray,
+                   lane: int = 1) -> Tuple[np.ndarray, np.ndarray]:
+    """layout (H, nb, nb) 0/1 -> flat nonzero-entry LUT (rows, cols), each
+    (H, N) int32 in row-major order, N = max per-head (padded) nnz.
+
+    The width-LUT (build_lut) makes every q-row pay the MAX row width in
+    grid iterations — O(nb * width) steps with most masked out at realistic
+    densities. The flat LUT spends ~one grid step per nonzero block pair,
+    so kernel work scales with nnz. Padding entries carry rows = nb-1 /
+    cols = -1: an invalid column contributes nothing, and a padded row id
+    of nb-1 either continues the genuine last row (harmless) or finalizes
+    an EMPTY last row with the correct zero output.
+
+    ``lane > 1``: each row's entry run is padded (with that row's id,
+    col = -1) to a multiple of ``lane``, so the kernels can consume `lane`
+    entries per grid step — one wide concatenated MXU dot and one online-
+    softmax update per step instead of `lane` narrow ones. Every group's
+    entries share a row id by construction.
+
+    Every row id appears at least once (empty rows get a full invalid
+    group) so the kernel still initializes and flushes every output block
+    (zeros / lse = -inf) instead of leaving uninitialized garbage."""
+    H, nb, _ = layout.shape
+    per = []
+    for h in range(H):
+        rs, cs = [], []
+        for qb in range(nb):
+            (idx,) = np.nonzero(layout[h, qb])
+            n = max(len(idx), 1)
+            padded = -np.ones(((n + lane - 1) // lane) * lane, np.int64)
+            padded[: len(idx)] = idx
+            rs.append(np.full(len(padded), qb, np.int64))
+            cs.append(padded)
+        per.append((np.concatenate(rs).astype(np.int32),
+                    np.concatenate(cs).astype(np.int32)))
+    N = max(lane, max(len(r) for r, _ in per))
+    N = ((N + lane - 1) // lane) * lane
+    rows = np.full((H, N), nb - 1, np.int32)
+    cols = np.full((H, N), -1, np.int32)
+    for h, (r, c) in enumerate(per):
+        rows[h, : len(r)] = r
+        cols[h, : len(c)] = c
+    return rows, cols
+
+
+# entries consumed per grid step: one wide concatenated MXU dot + one
+# online-softmax update per LANE LUT entries (per-step overhead amortizes,
+# dots widen from block to LANE*block — the per-flop gap vs flash)
+LANE = 4
+
+
+def _group_flags(rows_ref, cols_ref, h, i, n_entries):
+    """(row, first-of-row, last-of-row) for flat-LUT group i (LANE entries
+    starting at i*LANE; all share a row id by build_flat_lut construction).
+
+    first/last derive from adjacent SMEM entries; `last` also fires when
+    the next group is global padding (col < 0 with the same row id)."""
+    base = i * LANE
+    row = rows_ref[h, base]
+    prev_row = rows_ref[h, jnp.maximum(base - 1, 0)]
+    first = jnp.logical_or(base == 0, prev_row != row)
+    nxt = jnp.minimum(base + LANE, n_entries - 1)
+    last = jnp.logical_or(
+        base + LANE >= n_entries,
+        jnp.logical_or(rows_ref[h, nxt] != row, cols_ref[h, nxt] < 0),
+    )
+    return row, first, last
+
+
+def _concat_cols_mask(col_ids, block):
+    """(col-position matrix (block, LANE*block), additive validity mask):
+    per-chunk column positions for causal masking plus 0/-inf padding mask
+    (scalar select per chunk — fp32 additive, never a bool lane-vector
+    broadcast, which Mosaic cannot lower)."""
+    pos = []
+    add = []
+    for kb in col_ids:
+        iota = jax.lax.broadcasted_iota(jnp.int32, (block, block), 1)
+        pos.append(kb * block + iota)
+        addj = jnp.where(kb >= 0, 0.0, NEG_INF)
+        add.append(jnp.zeros((block, block), jnp.float32) + addj)
+    return jnp.concatenate(pos, axis=1), jnp.concatenate(add, axis=1)
+
+
 # ------------------------------------------------------------------ #
 # forward
 # ------------------------------------------------------------------ #
 
 
-def _bs_fwd_kernel(cols_ref, cnt_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
-                   m_scr, l_scr, acc_scr, *, sm_scale, block, causal,
-                   num_heads, width):
-    """One grid step = one (q-block, LUT-entry) pair; the k/v BLOCKS arrive
-    via LUT-driven BlockSpecs (streamed, double-buffered), the online-softmax
-    state lives in VMEM scratch across the LUT dim."""
+def _bs_fwd_kernel(rows_ref, cols_ref, q_ref, *rest, sm_scale, block, causal,
+                   num_heads, n_entries):
+    """One grid step = LANE nonzero (q-block, k-block) pairs of one row from
+    the flat LUT; the k/v blocks stream via LUT-driven BlockSpecs
+    (double-buffered), concatenate into one wide (LANE*block) MXU dot, and
+    the online-softmax state lives in VMEM scratch across a row's groups —
+    the output flushes when the row id changes."""
+    k_refs = rest[:LANE]
+    v_refs = rest[LANE:2 * LANE]
+    o_ref, lse_ref, m_scr, l_scr, acc_scr = rest[2 * LANE:]
     h = pl.program_id(0) % num_heads
-    qi = pl.program_id(1)
-    j = pl.program_id(2)
-    cnt = cnt_ref[h, qi]
-    kb = cols_ref[h, qi, j]
-    q_start = qi * block
+    i = pl.program_id(1)
+    row, first, last = _group_flags(rows_ref, cols_ref, h, i, n_entries)
+    col_ids = [cols_ref[h, i * LANE + j] for j in range(LANE)]
+    q_start = row * block
 
-    @pl.when(j == 0)
+    @pl.when(first)
     def _init():
         m_scr[...] = jnp.full_like(m_scr, NEG_INF)
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
     q = q_ref[0]  # (BLK, D) input dtype — bf16 MXU dots, fp32 accumulation
-    k = k_ref[0]
-    v = v_ref[0]
-    valid = j < cnt
+    k = jnp.concatenate([r[0] for r in k_refs], axis=0)  # (LANE*BLK, D)
+    v = jnp.concatenate([r[0] for r in v_refs], axis=0)
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * sm_scale  # (BLK, BLK)
+    ) * sm_scale  # (BLK, LANE*BLK)
+    pos, addmask = _concat_cols_mask(col_ids, block)
     if causal:
         rows = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-        cols = kb * block + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(rows >= cols, s, NEG_INF)
-    s = jnp.where(valid, s, NEG_INF)
+        s = jnp.where(rows >= pos, s, NEG_INF)
+    s = s + addmask
 
     m, l, acc = m_scr[...], l_scr[...], acc_scr[...]
     m_new = jnp.maximum(m, jnp.max(s, axis=-1))
@@ -152,7 +237,7 @@ def _bs_fwd_kernel(cols_ref, cnt_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         preferred_element_type=jnp.float32,
     )
 
-    @pl.when(j == width - 1)
+    @pl.when(last)
     def _finish():
         l = l_scr[...]
         m = m_scr[...]
@@ -163,32 +248,46 @@ def _bs_fwd_kernel(cols_ref, cnt_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         )
 
 
-def _bs_fwd(q, k, v, cols, counts, sm_scale, block, causal, interpret):
+def _row_spec(block, Dh, H):
+    return _vmem_spec(
+        (1, block, Dh), lambda b, i, r, c: (b, r[b % H, i * LANE], 0))
+
+
+def _lane_specs(block, Dh, H):
+    """LANE BlockSpecs fetching the j-th column block of group i."""
+    def at(j):
+        return _vmem_spec(
+            (1, block, Dh),
+            lambda b, i, r, c: (b, jnp.maximum(c[b % H, i * LANE + j], 0), 0))
+
+    return [at(j) for j in range(LANE)]
+
+
+def _bs_fwd(q, k, v, rows, cols, sm_scale, block, causal, interpret):
     B, S, H, Dh = q.shape
-    nb = S // block
     qf = q.transpose(0, 2, 1, 3).reshape(B * H, S, Dh)
     kf = k.transpose(0, 2, 1, 3).reshape(B * H, S, Dh)
     vf = v.transpose(0, 2, 1, 3).reshape(B * H, S, Dh)
-    width = cols.shape[-1]
-    grid = (B * H, nb, width)
+    n_entries = cols.shape[-1]
+    grid = (B * H, n_entries // LANE)
 
     kernel = functools.partial(
         _bs_fwd_kernel, sm_scale=sm_scale, block=block, causal=causal,
-        num_heads=H, width=width,
+        num_heads=H, n_entries=n_entries,
     )
     o, lse = _lut_pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            _vmem_spec((1, block, Dh), lambda b, i, j, c, n: (b, i, 0)),
-            _vmem_spec((1, block, Dh),
-                       lambda b, i, j, c, n: (b, c[b % H, i, j], 0)),
-            _vmem_spec((1, block, Dh),
-                       lambda b, i, j, c, n: (b, c[b % H, i, j], 0)),
-        ],
+        in_specs=(
+            [_row_spec(block, Dh, H)]
+            + _lane_specs(block, Dh, H)   # k blocks
+            + _lane_specs(block, Dh, H)   # v blocks
+        ),
         out_specs=[
-            _vmem_spec((1, block, Dh), lambda b, i, j, c, n: (b, i, 0)),
-            _vmem_spec((1, 1, block), lambda b, i, j, c, n: (b, 0, i)),
+            _vmem_spec((1, block, Dh),
+                       lambda b, i, r, c: (b, r[b % H, i * LANE], 0)),
+            _vmem_spec((1, 1, block),
+                       lambda b, i, r, c: (b, 0, r[b % H, i * LANE])),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B * H, S, Dh), q.dtype),
@@ -200,7 +299,7 @@ def _bs_fwd(q, k, v, cols, counts, sm_scale, block, causal, interpret):
         scratch_shapes=[_scratch((block,)), _scratch((block,)),
                         _scratch((block, Dh))],
         interpret=interpret,
-    )(cols, counts, qf, kf, vf)
+    )(rows, cols, qf, *([kf] * LANE), *([vf] * LANE))
     return o, lse, (qf, kf, vf)
 
 
@@ -209,17 +308,18 @@ def _bs_fwd(q, k, v, cols, counts, sm_scale, block, causal, interpret):
 # ------------------------------------------------------------------ #
 
 
-def _bs_bwd_dq_kernel(cols_ref, cnt_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
-                      delta_ref, dq_ref, dq_scr, *, sm_scale, block, causal,
-                      num_heads, width):
+def _bs_bwd_dq_kernel(rows_ref, cols_ref, q_ref, *rest, sm_scale, block,
+                      causal, num_heads, n_entries):
+    k_refs = rest[:LANE]
+    v_refs = rest[LANE:2 * LANE]
+    do_ref, lse_ref, delta_ref, dq_ref, dq_scr = rest[2 * LANE:]
     h = pl.program_id(0) % num_heads
-    qi = pl.program_id(1)
-    j = pl.program_id(2)
-    cnt = cnt_ref[h, qi]
-    kb = cols_ref[h, qi, j]
-    q_start = qi * block
+    i = pl.program_id(1)
+    row, first, last = _group_flags(rows_ref, cols_ref, h, i, n_entries)
+    col_ids = [cols_ref[h, i * LANE + j] for j in range(LANE)]
+    q_start = row * block
 
-    @pl.when(j == 0)
+    @pl.when(first)
     def _init():
         dq_scr[...] = jnp.zeros_like(dq_scr)
 
@@ -227,17 +327,16 @@ def _bs_bwd_dq_kernel(cols_ref, cnt_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     do = do_ref[0]
     lse = lse_ref[0, 0]
     delta = delta_ref[0, 0]
-    k = k_ref[0]
-    v = v_ref[0]
-    valid = j < cnt
+    k = jnp.concatenate([r[0] for r in k_refs], axis=0)  # (LANE*BLK, D)
+    v = jnp.concatenate([r[0] for r in v_refs], axis=0)
     s = sm_scale * jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    )
+    )  # (BLK, LANE*BLK)
+    pos, addmask = _concat_cols_mask(col_ids, block)
     if causal:
         rows = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-        cols = kb * block + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(rows >= cols, s, NEG_INF)
-    s = jnp.where(valid, s, NEG_INF)
+        s = jnp.where(rows >= pos, s, NEG_INF)
+    s = s + addmask
     p = jnp.exp(s - lse[:, None])
     # rows with no visible key stored lse=NEG_INF; exp(-1e30 - -1e30)=1
     # would poison them. Multiplicative fp32 mask, NOT a bool-vector where:
@@ -254,45 +353,65 @@ def _bs_bwd_dq_kernel(cols_ref, cnt_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         preferred_element_type=jnp.float32,
     )
 
-    @pl.when(j == width - 1)
+    @pl.when(last)
     def _finish():
         dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
 
 
-def _bs_bwd_dkdv_kernel(rows_ref, cnt_ref, q_ref, k_ref, v_ref, do_ref,
-                        lse_ref, delta_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
-                        sm_scale, block, causal, num_heads, width):
+def _bs_bwd_dkdv_kernel(keys_ref, qrows_ref, k_ref, v_ref, *rest, sm_scale,
+                        block, causal, num_heads, n_entries):
+    """Flat TRANSPOSED LUT (entries sorted by key-block): each grid step
+    consumes LANE attending q-blocks of one key block; scratch accumulates
+    dk/dv for that key block across its groups."""
+    q_refs = rest[:LANE]
+    do_refs = rest[LANE:2 * LANE]
+    lse_refs = rest[2 * LANE:3 * LANE]
+    delta_refs = rest[3 * LANE:4 * LANE]
+    dk_ref, dv_ref, dk_scr, dv_scr = rest[4 * LANE:]
     h = pl.program_id(0) % num_heads
-    ki = pl.program_id(1)
-    j = pl.program_id(2)
-    cnt = cnt_ref[h, ki]
-    qb = rows_ref[h, ki, j]
-    k_start = ki * block
+    i = pl.program_id(1)
+    kb, first, last = _group_flags(keys_ref, qrows_ref, h, i, n_entries)
+    row_ids = [qrows_ref[h, i * LANE + j] for j in range(LANE)]
+    k_start = kb * block
 
-    @pl.when(j == 0)
+    @pl.when(first)
     def _init():
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
 
     k = k_ref[0]  # input dtype
     v = v_ref[0]
-    q = q_ref[0]
-    do = do_ref[0]
-    lse = lse_ref[0, 0]
-    delta = delta_ref[0, 0]
-    valid = j < cnt
+    q = jnp.concatenate([r[0] for r in q_refs], axis=0)  # (LANE*BLK, D)
+    do = jnp.concatenate([r[0] for r in do_refs], axis=0)
+    # 2-D per-chunk broadcasts BEFORE the concat: Mosaic cannot concatenate
+    # 1-D vectors, while sublane-axis concat of (BLK, BLK) tiles lowers fine
+    lse = jnp.concatenate(
+        [jnp.zeros((block, block), jnp.float32) + r[0, 0][:, None]
+         for r in lse_refs], axis=0)  # (LANE*BLK, BLK)
+    delta = jnp.concatenate(
+        [jnp.zeros((block, block), jnp.float32) + r[0, 0][:, None]
+         for r in delta_refs], axis=0)
     s = sm_scale * jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    )  # (BQ, BK)
+    )  # (LANE*BLK, BLK)
+    # per-chunk q-row positions (concat along the ROW axis here) + additive
+    # validity mask for padded entries
+    rpos = []
+    radd = []
+    for qb in row_ids:
+        iota = jax.lax.broadcasted_iota(jnp.int32, (block, block), 0)
+        rpos.append(qb * block + iota)
+        addj = jnp.where(qb >= 0, 0.0, NEG_INF)
+        radd.append(jnp.zeros((block, block), jnp.float32) + addj)
+    rows = jnp.concatenate(rpos, axis=0)  # (LANE*BLK, BLK)
+    s = s + jnp.concatenate(radd, axis=0)
     if causal:
-        rows = qb * block + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
         cols = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         s = jnp.where(rows >= cols, s, NEG_INF)
-    s = jnp.where(valid, s, NEG_INF)
-    p = jnp.exp(s - lse[:, None])
+    p = jnp.exp(s - lse)
     # fp32 multiplicative mask, not a bool-vector where (see dq kernel)
     alive = (lse > NEG_INF / 2).astype(jnp.float32)
-    p = p * alive[:, None]
+    p = p * alive
     dv_scr[...] = dv_scr[...] + jax.lax.dot_general(
         p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
@@ -300,81 +419,101 @@ def _bs_bwd_dkdv_kernel(rows_ref, cnt_ref, q_ref, k_ref, v_ref, do_ref,
     dp = jax.lax.dot_general(
         do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     )
-    ds = p * (dp - delta[:, None]) * sm_scale
+    ds = p * (dp - delta) * sm_scale
     dk_scr[...] = dk_scr[...] + jax.lax.dot_general(
         ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
 
-    @pl.when(j == width - 1)
+    @pl.when(last)
     def _finish():
         dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
-def _bs_bwd(res, g, cols, counts, rows_t, counts_t, sm_scale, block, causal,
+def _lane_lse_specs(block, H):
+    """LANE (1, 1, block) specs following the j-th q-row of group i."""
+    def at(j):
+        return _vmem_spec(
+            (1, 1, block),
+            lambda b, i, kk, r: (b, 0,
+                                 jnp.maximum(r[b % H, i * LANE + j], 0)))
+
+    return [at(j) for j in range(LANE)]
+
+
+def _lane_qrow_specs(block, Dh, H):
+    """LANE (1, block, Dh) specs following the j-th q-row of group i
+    (transposed-LUT second prefetch array)."""
+    def at(j):
+        return _vmem_spec(
+            (1, block, Dh),
+            lambda b, i, kk, r: (b, jnp.maximum(r[b % H, i * LANE + j], 0),
+                                 0))
+
+    return [at(j) for j in range(LANE)]
+
+
+def _bs_bwd(res, g, rows, cols, keys_t, qrows_t, sm_scale, block, causal,
             interpret, num_heads):
     qf, kf, vf, o, lse = res
     BH, S, Dh = qf.shape
     H = num_heads
-    nb = S // block
     do = g
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     delta = delta.reshape(BH, 1, S)
-    width = cols.shape[-1]
-    width_t = rows_t.shape[-1]
+    n_entries = cols.shape[-1]
+    n_entries_t = qrows_t.shape[-1]
 
     dq = _lut_pallas_call(
         functools.partial(
             _bs_bwd_dq_kernel, sm_scale=sm_scale, block=block, causal=causal,
-            num_heads=H, width=width,
+            num_heads=H, n_entries=n_entries,
         ),
-        grid=(BH, nb, width),
-        in_specs=[
-            _vmem_spec((1, block, Dh), lambda b, i, j, c, n: (b, i, 0)),  # q
-            _vmem_spec((1, block, Dh),
-                       lambda b, i, j, c, n: (b, c[b % H, i, j], 0)),  # k
-            _vmem_spec((1, block, Dh),
-                       lambda b, i, j, c, n: (b, c[b % H, i, j], 0)),  # v
-            _vmem_spec((1, block, Dh), lambda b, i, j, c, n: (b, i, 0)),  # do
-            _vmem_spec((1, 1, block), lambda b, i, j, c, n: (b, 0, i)),  # lse
-            _vmem_spec((1, 1, block), lambda b, i, j, c, n: (b, 0, i)),  # dlt
-        ],
-        out_specs=_vmem_spec((1, block, Dh), lambda b, i, j, c, n: (b, i, 0)),
+        grid=(BH, n_entries // LANE),
+        in_specs=(
+            [_row_spec(block, Dh, H)]       # q
+            + _lane_specs(block, Dh, H)     # k blocks
+            + _lane_specs(block, Dh, H)     # v blocks
+            + [
+                _row_spec(block, Dh, H),    # do
+                _vmem_spec((1, 1, block),
+                           lambda b, i, r, c: (b, 0, r[b % H, i * LANE])),
+                _vmem_spec((1, 1, block),
+                           lambda b, i, r, c: (b, 0, r[b % H, i * LANE])),
+            ]
+        ),
+        out_specs=_vmem_spec((1, block, Dh),
+                             lambda b, i, r, c: (b, r[b % H, i * LANE], 0)),
         out_shape=jax.ShapeDtypeStruct((BH, S, Dh), qf.dtype),
         scratch_shapes=[_scratch((block, Dh))],
         interpret=interpret,
-    )(cols, counts, qf, kf, vf, do, lse, delta)
+    )(rows, cols, qf, *([kf] * LANE), *([vf] * LANE), do, lse, delta)
 
+    kb_spec = _vmem_spec((1, block, Dh),
+                         lambda b, i, kk, r: (b, kk[b % H, i * LANE], 0))
     dk, dv = _lut_pallas_call(
         functools.partial(
-            _bs_bwd_dkdv_kernel, sm_scale=sm_scale, block=block, causal=causal,
-            num_heads=H, width=width_t,
+            _bs_bwd_dkdv_kernel, sm_scale=sm_scale, block=block,
+            causal=causal, num_heads=H, n_entries=n_entries_t,
         ),
-        grid=(BH, nb, width_t),
-        in_specs=[
-            _vmem_spec((1, block, Dh),
-                       lambda b, i, j, r, n: (b, r[b % H, i, j], 0)),  # q
-            _vmem_spec((1, block, Dh), lambda b, i, j, r, n: (b, i, 0)),  # k
-            _vmem_spec((1, block, Dh), lambda b, i, j, r, n: (b, i, 0)),  # v
-            _vmem_spec((1, block, Dh),
-                       lambda b, i, j, r, n: (b, r[b % H, i, j], 0)),  # do
-            _vmem_spec((1, 1, block),
-                       lambda b, i, j, r, n: (b, 0, r[b % H, i, j])),  # lse
-            _vmem_spec((1, 1, block),
-                       lambda b, i, j, r, n: (b, 0, r[b % H, i, j])),  # dlt
-        ],
-        out_specs=[
-            _vmem_spec((1, block, Dh), lambda b, i, j, r, n: (b, i, 0)),
-            _vmem_spec((1, block, Dh), lambda b, i, j, r, n: (b, i, 0)),
-        ],
+        grid=(BH, n_entries_t // LANE),
+        in_specs=(
+            [kb_spec, kb_spec]                    # k, v
+            + _lane_qrow_specs(block, Dh, H)      # q blocks
+            + _lane_qrow_specs(block, Dh, H)      # do blocks
+            + _lane_lse_specs(block, H)           # lse blocks
+            + _lane_lse_specs(block, H)           # delta blocks
+        ),
+        out_specs=[kb_spec, kb_spec],
         out_shape=[
             jax.ShapeDtypeStruct((BH, S, Dh), qf.dtype),
             jax.ShapeDtypeStruct((BH, S, Dh), qf.dtype),
         ],
         scratch_shapes=[_scratch((block, Dh)), _scratch((block, Dh))],
         interpret=interpret,
-    )(rows_t, counts_t, qf, kf, vf, do, lse, delta)
+    )(keys_t, qrows_t, kf, vf, *([qf] * LANE), *([do] * LANE),
+      *([lse] * LANE), *([delta] * LANE))
     return dq, dk, dv
 
 
@@ -398,20 +537,20 @@ def make_block_sparse_attention(layout: np.ndarray, block: int,
     # the factory is first invoked inside someone else's jit trace (ops are
     # cached per seq-len — a cached tracer poisons every later call with
     # UnexpectedTracerError). numpy constants bind safely into any trace.
-    cols, counts = build_lut(layout)
-    rows_t, counts_t = build_lut(layout.transpose(0, 2, 1))
+    rows, cols = build_flat_lut(layout, lane=LANE)
+    keys_t, qrows_t = build_flat_lut(layout.transpose(0, 2, 1), lane=LANE)
 
     @jax.custom_vjp
     def attend(q, k, v):
         scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
-        o, _, _ = _bs_fwd(q, k, v, cols, counts, scale, block, causal, interpret)
+        o, _, _ = _bs_fwd(q, k, v, rows, cols, scale, block, causal, interpret)
         B, S, _, Dh = q.shape
         return o.reshape(B, H, S, Dh).transpose(0, 2, 1, 3)
 
     def fwd(q, k, v):
         scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
         o, lse, (qf, kf, vf) = _bs_fwd(
-            q, k, v, cols, counts, scale, block, causal, interpret
+            q, k, v, rows, cols, scale, block, causal, interpret
         )
         B, S, _, Dh = q.shape
         out = o.reshape(B, H, S, Dh).transpose(0, 2, 1, 3)
@@ -421,7 +560,7 @@ def make_block_sparse_attention(layout: np.ndarray, block: int,
         qf, kf, vf, o, lse, scale, (B, S, H_, Dh) = res
         gf = g.transpose(0, 2, 1, 3).reshape(B * H_, S, Dh)
         dq, dk, dv = _bs_bwd(
-            (qf, kf, vf, o, lse), gf, cols, counts, rows_t, counts_t, scale,
+            (qf, kf, vf, o, lse), gf, rows, cols, keys_t, qrows_t, scale,
             block, causal, interpret, H_,
         )
         unflat = lambda x: x.reshape(B, H_, S, Dh).transpose(0, 2, 1, 3)

@@ -29,7 +29,10 @@ def _pallas_ok(block: int, Dh: int) -> bool:
             return False
     except Exception:  # pragma: no cover
         return False
-    return block % 8 == 0 and Dh % 8 == 0
+    # Mosaic lane rule: the lse/delta outputs carry (1, 1, block) tiles, so
+    # the sparsity block must be a lane multiple (128) on hardware — %8
+    # alone compiles in interpret mode but fails Mosaic lowering
+    return block % 128 == 0 and Dh % 8 == 0
 
 
 class SparseSelfAttention:
