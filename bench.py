@@ -140,6 +140,18 @@ def main():
         dts.append((time.perf_counter() - t0) / steps)
     dt = min(dts)
 
+    # secondary measured metrics (BERT-large ZeRO-2 + sparse-vs-dense
+    # attention), produced by scripts/bert_sparse_bench.py; embedded only
+    # when they were measured on the same platform as this run
+    extra = None
+    extra_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "BENCH_EXTRA.json")
+    if os.path.isfile(extra_path):
+        with open(extra_path) as f:
+            candidate = json.load(f)
+        if candidate.get("platform") == jax.devices()[0].platform:
+            extra = candidate
+
     tokens_per_step = micro * gas * dp * seq
     tokens_per_sec_per_chip = tokens_per_step / dt / max(1, len(jax.devices()))
     # total training flops/token: fwd 2N + bwd 4N over matmul params, plus
@@ -165,6 +177,7 @@ def main():
                     "mfu": round(mfu, 4),
                     "loss": round(float(jax.device_get(loss)), 4),
                     "platform": jax.devices()[0].platform,
+                    **({"extra_benchmarks": extra} if extra else {}),
                 },
             }
         )

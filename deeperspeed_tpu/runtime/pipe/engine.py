@@ -501,6 +501,7 @@ class PipelineEngine(ConfigAccessorsMixin):
             self.skipped_steps += 1
             self.stage_grads = [None] * self.num_stages
             self._last_grad_norm = gnorm
+            self._last_step_skipped = True
             self._update_loss_scale(overflow=True)
             log_dist(
                 f"non-finite grad norm {gnorm}; skipping step "
@@ -513,6 +514,7 @@ class PipelineEngine(ConfigAccessorsMixin):
         # the lr actually APPLIED this step — monitoring reads this, not
         # _current_lr(), which the scheduler advances just below
         self._last_applied_lr = float(lr)
+        self._last_step_skipped = False
 
         for s in range(self.num_stages):
             g = self.stage_grads[s]
@@ -680,10 +682,13 @@ class PipelineEngine(ConfigAccessorsMixin):
         self.micro_steps += self.micro_batches
         loss = self._aggregate_total_loss()
         self.tput_timer.stop(global_step=True, sync_with=None)
-        if self.summary_writer is not None:
+        if (self.summary_writer is not None
+                and not getattr(self, "_last_step_skipped", False)):
             # loss is already a host float (_aggregate_total_loss fetched
             # it), so the write adds no extra device sync; flush rides the
-            # steps_per_print cadence rather than every batch
+            # steps_per_print cadence rather than every batch. Overflow-
+            # skipped steps are not written: no lr was applied and
+            # global_samples did not advance (the x key would duplicate)
             scalars = {
                 "Train/Samples/lr": getattr(self, "_last_applied_lr",
                                             self._current_lr()),
