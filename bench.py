@@ -19,7 +19,12 @@ Measured points on the v5e tunnel chip (2026-07, for regression reference):
   neox-1.3b mb2 gas8 remat=matmuls ce128 masterless: ~14.2k tok/s/chip
   (honest matmul-only flops accounting; first 1-2 steps after compile are
   allocator warmup and must be excluded from timing)
-GPT-125M (DS_BENCH_MODEL=125m): mb12 no-remat ~81k tok/s.
+GPT-125M (DS_BENCH_MODEL=125m): mb12 no-remat ~81-85k tok/s (~35% MFU).
+The 125M gap to the 1.3B run's 59% is shape-limited, not framework
+overhead: scripts/matmul_ceiling.py measures the chip's per-shape matmul
+ceilings (D=768 square ~11 TF / ffn ~43 TF vs D=2048 ffn ~137 TF;
+results in MATMUL_CEILING.json) — the 125M layer stack runs ABOVE its
+own layer-shape ceiling thanks to the wide logits matmul.
 """
 
 import json
