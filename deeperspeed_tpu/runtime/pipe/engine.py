@@ -101,6 +101,17 @@ class PipelineEngine(ConfigAccessorsMixin):
         rng=None,
     ):
         assert isinstance(module, PipelineModule)
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                "the host-driven PipelineEngine is single-controller: its "
+                "stage p2p is a device_put between sub-meshes, which cannot "
+                "cross process boundaries. For multi-process pipeline "
+                "parallelism use the single-program SPMD pipeline "
+                "(runtime/pipe/spmd.make_spmd_pipeline_train_step) with a "
+                "'pipe' mesh axis spanning the processes — stage transfers "
+                "compile to XLA collectives over ICI/DCN (see "
+                "tests/dist_worker.py phase 4)."
+            )
         self.module = module
         self._config = config
         self.num_stages = module.num_stages

@@ -212,6 +212,23 @@ def main():
     print(f"rank{jax.process_index()}: cross-process 1F1B pipeline ok "
           f"(loss {pipe_loss:.6f})", flush=True)
 
+    # the host-driven PipelineEngine is single-controller: it must refuse
+    # multi-process construction with a pointer at the SPMD path
+    from deeperspeed_tpu.runtime.config import TrainingConfig
+    from deeperspeed_tpu.runtime.pipe import LayerSpec, Linear, PipelineModule
+    from deeperspeed_tpu.runtime.pipe.engine import PipelineEngine
+
+    try:
+        PipelineEngine(
+            PipelineModule([LayerSpec(Linear, 4, 4)], num_stages=1),
+            TrainingConfig({"train_batch_size": 2,
+                            "train_micro_batch_size_per_gpu": 1,
+                            "gradient_accumulation_steps": 2}),
+        )
+        raise AssertionError("PipelineEngine accepted multi-process")
+    except NotImplementedError:
+        pass
+
     if jax.process_index() == 0:
         with open(result_file, "w") as f:
             f.write(
