@@ -1,3 +1,4 @@
 from .gpt import GPTConfig, make_gpt, get_preset
 from .bert import BertConfig, make_bert, params_from_hf
 from .generation import make_generator, init_cache, apply_with_cache
+from .speculative import make_speculative_generator

@@ -1,0 +1,134 @@
+"""Speculative decoding: draft-model proposal + single-pass target verify.
+
+Beyond the reference (whose generation path recomputes the prefix per
+token through ``PipelineEngine.inference_batch``): a small DRAFT model
+proposes K tokens autoregressively, then the TARGET model scores all K+1
+positions in ONE cached forward; matching tokens are accepted and the
+target's own prediction at the first mismatch is emitted as the bonus
+token. Greedy (temperature=0) acceptance makes the output BIT-IDENTICAL
+to plain greedy decoding of the target model, for any draft — the draft
+only changes how many target forwards are needed (1 per ~n_accepted+1
+tokens instead of 1 per token).
+
+Precision caveat (measured on the v5e chip): the guarantee holds exactly
+when the verify pass's logits match per-token logits bitwise — true in
+fp32; under bf16 the batched (K+1)-token matmuls reduce in a different
+order than S=1 decode steps, so near-tie argmaxes can flip and sequences
+may diverge at such positions (either branch is a legitimate greedy
+decode; this is the usual batched-vs-incremental nondeterminism, not an
+acceptance-logic error).
+
+TPU-native shape discipline: everything is static — the outer loop is a
+``lax.while_loop`` whose body always drafts exactly K tokens and verifies
+K+1; accepted counts vary as DATA (masked writes into a preallocated
+output buffer, offsets advance by the accepted length). Stale KV-cache
+entries beyond the rolled-back offset need no cleanup: the attention mask
+is offset-derived, so they are invisible until overwritten.
+
+Usage::
+
+    gen = make_speculative_generator(target_cfg, draft_cfg, k_draft=4)
+    out = gen(target_params, draft_params, prompt, max_new_tokens=64)
+
+Batch size 1 (the speculative serving case; per-row accept counts would
+need per-row cache offsets).
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from .generation import apply_with_cache, init_cache
+from .gpt import GPTConfig
+
+
+def make_speculative_generator(target_cfg: GPTConfig, draft_cfg: GPTConfig,
+                               k_draft: int = 4):
+    """Build a jitted speculative generate(target_params, draft_params,
+    prompt, max_new_tokens) -> (B, S+max_new_tokens) tokens (greedy)."""
+    assert target_cfg.vocab_size == draft_cfg.vocab_size, (
+        "target and draft must share a vocabulary")
+    K = int(k_draft)
+    assert K >= 1
+
+    @partial(jax.jit, static_argnames=("max_new_tokens",))
+    def generate(target_params, draft_params, prompt, max_new_tokens: int):
+        B, S = prompt.shape
+        if B != 1:
+            raise ValueError(
+                "speculative decoding supports batch size 1 (per-row accept "
+                f"counts would need per-row cache offsets); got B={B}")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        # slack: the final block may draft past the requested length
+        max_len = S + max_new_tokens + K + 1
+        for cfg in (target_cfg, draft_cfg):
+            if not cfg.rotary and max_len > cfg.max_seq:
+                raise ValueError(
+                    f"prompt ({S}) + max_new_tokens ({max_new_tokens}) + "
+                    f"draft slack ({K + 1}) exceeds max_seq ({cfg.max_seq})")
+
+        t_cache = init_cache(target_cfg, B, max_len)
+        d_cache = init_cache(draft_cfg, B, max_len)
+        t_logits, t_cache = apply_with_cache(
+            target_cfg, target_params, prompt, t_cache, 0)
+        _, d_cache = apply_with_cache(
+            draft_cfg, draft_params, prompt, d_cache, 0)
+        first = jnp.argmax(t_logits[:, -1], axis=-1).astype(jnp.int32)  # (B,)
+
+        out = jnp.zeros((B, max_new_tokens + K + 1), jnp.int32)
+        out = jax.lax.dynamic_update_slice(out, first[:, None], (0, 0))
+
+        # invariant at loop top: `n` tokens emitted (out[:, :n]); `last` is
+        # the newest emitted token, NOT yet in either cache; both caches
+        # hold exactly the S + n - 1 tokens before it.
+        def cond(carry):
+            n = carry[1]
+            return n < max_new_tokens
+
+        def body(carry):
+            out, n, last, t_cache, d_cache = carry
+            offset = S + n - 1  # tokens in both caches
+
+            # --- draft phase: propose K tokens (and cache d_K too, so the
+            # draft cache stays ahead even on full acceptance) ---
+            def draft_step(carry, j):
+                tok, cache = carry
+                logits, cache = apply_with_cache(
+                    draft_cfg, draft_params, tok[:, None], cache, offset + j)
+                nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+                return (nxt, cache), nxt
+
+            (_, d_cache), drafts = jax.lax.scan(
+                draft_step, (last, d_cache), jnp.arange(K + 1))
+            drafts = drafts[:K, 0]  # (K,) proposed tokens d_1..d_K
+
+            # --- verify phase: one target forward over [last, d_1..d_K] ---
+            block = jnp.concatenate([last, drafts], axis=0)[None]  # (1, K+1)
+            t_logits, t_cache = apply_with_cache(
+                target_cfg, target_params, block, t_cache, offset)
+            t_preds = jnp.argmax(t_logits[0], axis=-1).astype(jnp.int32)
+            # t_preds[j] = target's token after consuming block[:j+1]
+
+            # --- acceptance: longest prefix where draft == target ---
+            matches = (drafts == t_preds[:K]).astype(jnp.int32)
+            n_acc = jnp.sum(jnp.cumprod(matches))  # 0..K
+
+            # emitted this round: accepted drafts then the target's token
+            # at the first mismatch (or bonus token on full acceptance)
+            idx = jnp.arange(K + 1, dtype=jnp.int32)
+            bonus = t_preds[n_acc]
+            emitted = jnp.where(idx < n_acc, jnp.append(drafts, 0), bonus)
+            # positions >= n_acc+1 hold `bonus` copies: they are either
+            # overwritten by the next round's write at n + n_acc + 1 or
+            # fall beyond max_new_tokens and are sliced off.
+            out = jax.lax.dynamic_update_slice(out, emitted[None], (0, n))
+            return (out, n + n_acc + 1, bonus[None], t_cache, d_cache)
+
+        out, _, _, _, _ = jax.lax.while_loop(
+            cond, body, (out, jnp.int32(1), first, t_cache, d_cache))
+        return jnp.concatenate([prompt, out[:, :max_new_tokens]], axis=1)
+
+    return generate

@@ -5,7 +5,10 @@ This bench registers a realistic optimizer-shard working set (default 32
 chunks x 24 MB of master+moments = 768 MB, about one dp=8 rank's share of
 a 2B-param model) and measures:
 
-  1. raw swap_in / swap_out bandwidth (PartitionedOptimizerSwapper),
+  1. raw swap_in / swap_out bandwidth (PartitionedOptimizerSwapper) —
+     NOTE: on filesystems without O_DIRECT (thread-pool pread fallback)
+     the read sweep re-reads files just written and can measure the page
+     cache; size --chunks/--mb past RAM for device-level numbers,
   2. the full read -> CPU-Adam step -> write sweep, sequential
      (PartitionedOptimizerSwapper) vs double-buffered
      (PipelinedOptimizerSwapper) — the overlap win is the reason the

@@ -1,0 +1,97 @@
+"""Speculative decoding: greedy output must be BIT-IDENTICAL to plain
+greedy decoding of the target model, regardless of draft quality — the
+draft only changes how much work verification does.
+
+These tests run fp32, where the parity guarantee is exact; under bf16 the
+batched verify pass can flip near-tie argmaxes vs per-token decoding (see
+models/speculative.py docstring — hardware-verified both ways)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeperspeed_tpu.models.generation import make_generator
+from deeperspeed_tpu.models.gpt import GPTConfig, make_gpt
+from deeperspeed_tpu.models.speculative import make_speculative_generator
+
+
+def _cfg(n_layer, d_model=32, vocab=97, rotary=True):
+    return GPTConfig(
+        vocab_size=vocab, n_layer=n_layer, n_head=2, d_model=d_model,
+        max_seq=256, dtype=jnp.float32, remat=False, attn_impl="xla",
+        rotary=rotary, ce_chunk=0,
+    )
+
+
+@pytest.fixture(scope="module")
+def models():
+    tcfg, dcfg = _cfg(3), _cfg(1)
+    t_init, *_ = make_gpt(tcfg)
+    d_init, *_ = make_gpt(dcfg)
+    return (tcfg, t_init(jax.random.PRNGKey(0)),
+            dcfg, d_init(jax.random.PRNGKey(1)))
+
+
+def test_matches_plain_greedy_with_weak_draft(models):
+    """An unrelated random draft mostly mispredicts -> near-zero acceptance
+    -> the verify path must still reproduce plain greedy exactly."""
+    tcfg, tparams, dcfg, dparams = models
+    prompt = jnp.asarray([[5, 17, 3]], jnp.int32)
+    ref = make_generator(tcfg)(tparams, prompt, max_new_tokens=24)
+    spec = make_speculative_generator(tcfg, dcfg, k_draft=4)(
+        tparams, dparams, prompt, max_new_tokens=24)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(spec))
+
+
+def test_matches_plain_greedy_with_perfect_draft(models):
+    """Draft == target: every proposal accepted (the fast path) — output
+    must still be identical."""
+    tcfg, tparams, _, _ = models
+    prompt = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+    ref = make_generator(tcfg)(tparams, prompt, max_new_tokens=17)
+    spec = make_speculative_generator(tcfg, tcfg, k_draft=3)(
+        tparams, tparams, prompt, max_new_tokens=17)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(spec))
+
+
+@pytest.mark.parametrize("k_draft", [1, 2, 5])
+def test_k_draft_sweep(models, k_draft):
+    tcfg, tparams, dcfg, dparams = models
+    prompt = jnp.asarray([[9, 8]], jnp.int32)
+    ref = make_generator(tcfg)(tparams, prompt, max_new_tokens=11)
+    spec = make_speculative_generator(tcfg, dcfg, k_draft=k_draft)(
+        tparams, dparams, prompt, max_new_tokens=11)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(spec))
+
+
+def test_learned_positions_guard():
+    tcfg = _cfg(2, rotary=False)
+    dcfg = _cfg(1, rotary=False)
+    t_init, *_ = make_gpt(tcfg)
+    d_init, *_ = make_gpt(dcfg)
+    gen = make_speculative_generator(tcfg, dcfg, k_draft=4)
+    prompt = jnp.zeros((1, 250), jnp.int32)
+    with pytest.raises(ValueError, match="draft slack"):
+        gen(t_init(jax.random.PRNGKey(0)), d_init(jax.random.PRNGKey(1)),
+            prompt, max_new_tokens=4)
+
+
+def test_vocab_mismatch_rejected():
+    with pytest.raises(AssertionError, match="vocabulary"):
+        make_speculative_generator(_cfg(2, vocab=97), _cfg(1, vocab=64))
+
+
+def test_gqa_draft_composes(models):
+    """A GQA draft (n_kv_head=1) against an MHA target."""
+    tcfg, tparams, _, _ = models
+    dcfg = dataclasses.replace(_cfg(1), n_kv_head=1)
+    d_init, *_ = make_gpt(dcfg)
+    dparams = d_init(jax.random.PRNGKey(2))
+    prompt = jnp.asarray([[4, 4, 2]], jnp.int32)
+    ref = make_generator(tcfg)(tparams, prompt, max_new_tokens=9)
+    spec = make_speculative_generator(tcfg, dcfg, k_draft=3)(
+        tparams, dparams, prompt, max_new_tokens=9)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(spec))
