@@ -704,6 +704,53 @@ class Engine(ConfigAccessorsMixin):
 
         return self._get_compiled("offload_reshard", build)
 
+    def _resolve_offload_sd(self, ck, optim_states, model_states):
+        """This rank's offload state dict for load_checkpoint.
+
+        Fast path (same topology): only this rank's own file is read — the
+        main optim file for rank 0, its zero_pp_rank file otherwise. Only
+        when the saved chunks do not match this run's layout (mesh change)
+        is the merged all-rank view built, bounded by the process count
+        recorded at save time so stale higher-rank files from an older
+        save into the same tag are ignored."""
+        import json as _json
+
+        def _meta(d):
+            m = d.get("chunk_meta")
+            return _json.loads(m) if isinstance(m, (str, bytes)) else (m or {})
+
+        own = optim_states.get("offload")
+        if jax.process_count() > 1 and jax.process_index() != 0:
+            rf = optim_state_filename(jax.process_index())
+            own = ck.load(rf).get("offload") if ck.exists(rf) else None
+        if own is not None and self._offload.chunks_match(own):
+            return own
+
+        saved_procs = int(model_states.get("process_count", 0))
+        merged = optim_states.get("offload")
+        merged = dict(merged) if merged else None
+        rank = 1
+        while (rank < saved_procs) if saved_procs else ck.exists(
+                optim_state_filename(rank)):
+            rf = optim_state_filename(rank)
+            rank += 1
+            if not ck.exists(rf):
+                continue
+            rank_sd = ck.load(rf).get("offload")
+            if not rank_sd:
+                continue
+            if merged is None:
+                merged = dict(rank_sd)
+            else:
+                merged["states"] = {**merged["states"], **rank_sd["states"]}
+                merged["chunk_meta"] = {**_meta(merged), **_meta(rank_sd)}
+        if merged is None and jax.process_count() > 1:
+            logger.warning(
+                "no offload state found in checkpoint; optimizer moments "
+                "reset"
+            )
+        return merged
+
     def _to_master_sharded(self, params):
         """jitted identity: any params placement -> fp32 master sharding
         (scatter each process its chunks)."""
@@ -1172,6 +1219,9 @@ class Engine(ConfigAccessorsMixin):
             "micro_steps": self.micro_steps,
             "dp_world_size": self.data_parallel_size,
             "mp_world_size": int(self.mesh.shape.get("model", 1)),
+            # bounds the per-rank offload-file scan on load (stale files
+            # from an older, larger save into the same tag are ignored)
+            "process_count": jax.process_count(),
             "lr_scheduler": (
                 self.lr_scheduler.state_dict() if self.lr_scheduler else {}
             ),
@@ -1408,18 +1458,8 @@ class Engine(ConfigAccessorsMixin):
             optim_state_filename()
         ):
             optim_states = ck.load(optim_state_filename())
-            off_sd = optim_states.get("offload")
-            if (self._offload is not None and jax.process_count() > 1
-                    and jax.process_index() != 0):
-                # per-rank offload files (see save_checkpoint)
-                rank_file = optim_state_filename(jax.process_index())
-                off_sd = (ck.load(rank_file).get("offload")
-                          if ck.exists(rank_file) else None)
-                if off_sd is None:
-                    logger.warning(
-                        "no per-rank offload state %s in checkpoint; this "
-                        "rank's optimizer moments reset", rank_file
-                    )
+            off_sd = (self._resolve_offload_sd(ck, optim_states, model_states)
+                      if self._offload is not None else None)
             if self._offload is not None and off_sd:
                 self._offload.load_state_dict(off_sd)
                 # refresh device params from the restored master copy

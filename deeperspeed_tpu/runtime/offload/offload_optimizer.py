@@ -214,40 +214,148 @@ class HostOffloadOptimizer:
                              for k, v in self.swapper.unpack(cname, buf).items()}
         return states
 
+    def _chunk_meta(self) -> dict:
+        """Per-chunk slice geometry enabling cross-topology restore: the
+        (start, stop) of every dimension plus the leaf's full shape."""
+        meta = {}
+        for i, name in enumerate(self.leaf_names):
+            for key, cshape in self.chunk_shapes[i].items():
+                starts = [int(s) for s in key.split("-")] if key else []
+                meta[f"{name}@{key}"] = {
+                    "leaf": name,
+                    "leaf_shape": list(self.shapes[i]),
+                    "index": [[s, s + d] for s, d in zip(starts, cshape)],
+                }
+        return meta
+
     def state_dict(self) -> dict:
         """This PROCESS's chunk states (per-rank, like the reference's
-        mp_rank optimizer checkpoint files)."""
+        mp_rank optimizer checkpoint files). Chunk slice metadata rides
+        along so a differently-sharded run can reshard on load."""
+        import json
+
         return {
             "step": self.step_count,
             "states": self._all_states(),
             "device": self.device,
+            # JSON blob: the msgpack tree serializer would turn the nested
+            # int lists into string-keyed dicts
+            "chunk_meta": json.dumps(self._chunk_meta()),
         }
+
+    _STATE_KEYS = ("master", "exp_avg", "exp_avg_sq")
+
+    def _expected_sizes(self) -> Dict[str, int]:
+        sizes = {}
+        for i, name in enumerate(self.leaf_names):
+            for key, cshape in self.chunk_shapes[i].items():
+                sizes[f"{name}@{key}"] = int(
+                    np.prod(cshape, dtype=np.int64))
+        return sizes
+
+    def chunks_match(self, sd: dict) -> bool:
+        """True when ``sd`` carries exactly this run's chunk layout — a
+        chunk matches only when present AND the same size (different
+        topologies can produce overlapping slice-start keys, e.g. "0-8"
+        exists at both dp=4 and dp=8, with different extents)."""
+        states = sd.get("states", {})
+        return all(
+            c in states and np.asarray(states[c]["master"]).size == size
+            for c, size in self._expected_sizes().items()
+        )
 
     def load_state_dict(self, sd: dict):
         self.step_count = int(sd["step"])
-        missing = [c for c in self.chunk_names if c not in sd["states"]]
+        missing = not self.chunks_match(sd)
+        if missing and sd.get("chunk_meta"):
+            # universal restore: the checkpoint was chunked for a different
+            # mesh — reassemble full leaves from its slice metadata and
+            # re-slice into this run's chunks (beyond the reference, whose
+            # ZeRO checkpoints of this era were topology-bound)
+            return self._load_resharded(sd)
         if missing:
             raise ValueError(
                 "offload checkpoint does not match this run's shard "
-                f"topology: {len(missing)}/{len(self.chunk_names)} chunk "
-                f"keys absent (e.g. {missing[0]!r}). Offload optimizer "
-                "state is chunked per master shard and therefore bound to "
-                "the device mesh it was saved on (like the reference's "
-                "per-rank ZeRO checkpoints); to move across topologies, "
-                "restore params via checkpoint.sharded_io (elastic "
+                "topology (chunk keys/sizes differ) and carries no "
+                "chunk_meta to reshard from (pre-metadata checkpoint). "
+                "Restore params via checkpoint.sharded_io (elastic "
                 "re-shard) and let the moments restart."
             )
         for cname in self.chunk_names:
             src = sd["states"][cname]
             if self.device == "cpu":
-                for k in ("master", "exp_avg", "exp_avg_sq"):
+                for k in self._STATE_KEYS:
                     np.copyto(self._ram[cname][k], np.asarray(src[k]))
             else:
                 self.swapper.swap_out(
                     cname,
                     {k: np.ascontiguousarray(np.asarray(src[k]))
-                     for k in ("master", "exp_avg", "exp_avg_sq")},
+                     for k in self._STATE_KEYS},
                     async_op=False)
+
+    def _load_resharded(self, sd: dict):
+        """Cross-topology restore: scatter every available saved chunk into
+        full per-leaf fp32 arrays (verifying complete coverage), then slice
+        out this run's chunk layout."""
+        meta = sd["chunk_meta"]
+        states = sd["states"]
+        if isinstance(meta, (str, bytes)):
+            import json
+
+            meta = json.loads(meta)
+        full: Dict[str, Dict[str, np.ndarray]] = {}
+        covered: Dict[str, np.ndarray] = {}
+        for cname, m in meta.items():
+            if cname not in states:
+                continue
+            leaf = m["leaf"]
+            shape = tuple(m["leaf_shape"])
+            if leaf not in full:
+                full[leaf] = {k: np.zeros(shape, np.float32)
+                              for k in self._STATE_KEYS}
+                covered[leaf] = np.zeros(shape, bool)
+            sl = tuple(slice(a, b) for a, b in m["index"])
+            cshape = tuple(b - a for a, b in m["index"])
+            for k in self._STATE_KEYS:
+                full[leaf][k][sl] = np.asarray(
+                    states[cname][k], np.float32).reshape(cshape)
+            covered[leaf][sl] = True
+
+        problems = []
+        for i, name in enumerate(self.leaf_names):
+            if name not in full:
+                problems.append(f"{name}: absent from checkpoint")
+            elif full[name]["master"].shape != self.shapes[i]:
+                problems.append(
+                    f"{name}: shape {full[name]['master'].shape} != "
+                    f"{self.shapes[i]}")
+            elif not covered[name].all():
+                problems.append(
+                    f"{name}: only {covered[name].mean():.0%} of elements "
+                    "covered (merge every rank's zero_pp_rank file before "
+                    "resharding)")
+        if problems:
+            raise ValueError(
+                "cannot reshard offload checkpoint: "
+                + "; ".join(problems[:3]))
+
+        for i, name in enumerate(self.leaf_names):
+            for key, cshape in self.chunk_shapes[i].items():
+                starts = [int(s) for s in key.split("-")] if key else []
+                sl = tuple(slice(s, s + d) for s, d in zip(starts, cshape))
+                cname = f"{name}@{key}"
+                chunk = {k: np.ascontiguousarray(full[name][k][sl].ravel())
+                         for k in self._STATE_KEYS}
+                if self.device == "cpu":
+                    for k in self._STATE_KEYS:
+                        np.copyto(self._ram[cname][k], chunk[k])
+                else:
+                    self.swapper.swap_out(cname, chunk, async_op=False)
+        log_dist(
+            f"offload checkpoint resharded across topologies: "
+            f"{len(meta)} saved chunks -> {len(self.chunk_names)} local",
+            ranks=[0],
+        )
 
     def set_master_params(self, master_params):
         """Overwrite the host fp32 masters from a MASTER-SHARDED device
