@@ -79,7 +79,7 @@ class GPTConfig:
     moe_capacity_factor: float = 1.25
     moe_aux_coef: float = 0.01
     moe_z_coef: float = 1e-3
-    moe_dispatch_impl: str = "auto"  # "auto" | "dense" | "sorted"
+    moe_dispatch_impl: str = "auto"  # auto | dense | sorted | dropless
     moe_normalize_gates: bool = False
 
     @property

@@ -49,7 +49,11 @@ class MoEConfig:
     #   O(T*k*(log(T*k) + D)), independent of E, the scalable path for
     #   E >= ~16. "auto" picks by num_experts. Both produce identical
     #   buffers (same drop order), so they are loss-equivalent.
-    dispatch_impl: str = "auto"  # "auto" | "dense" | "sorted"
+    #   "dropless": MegaBlocks-style — sorted assignments feed
+    #   jax.lax.ragged_dot grouped matmuls with NO capacity and NO token
+    #   drops (dropped_frac is identically 0). Single-shard experts only
+    #   (does not compose with the 'expert' mesh axis yet).
+    dispatch_impl: str = "auto"  # "auto" | "dense" | "sorted" | "dropless"
 
     # Combine weights default to RAW softmax probabilities (Switch-style:
     # the mass of unselected experts damps the MoE branch, the residual
@@ -197,6 +201,46 @@ def router_z_loss(logits):
     return jnp.mean(jax.scipy.special.logsumexp(logits, axis=-1) ** 2)
 
 
+def _moe_ffn_dropless(params, x, cfg: MoEConfig, act, logits, mesh):
+    """MegaBlocks-style dropless dispatch: assignments sorted by expert
+    feed ``jax.lax.ragged_dot`` grouped matmuls — every token is processed
+    (no capacity, no drops), and compute scales with T*k regardless of the
+    load distribution across experts."""
+    B, S, D = x.shape
+    E, k = cfg.num_experts, cfg.top_k
+    T = B * S
+    xt = x.reshape(T, D)
+    probs, expert_idx, gate = router_topk(logits, k, cfg.normalize_gates)
+    # capacity = k*T keeps every assignment; reuse the shared sorter
+    order, tid_s, e_s, _pos_s, _keep_s = sorted_assignments(
+        expert_idx, k * T, E)
+    gate_s = gate.T.reshape(-1)[order]
+    group_sizes = jnp.zeros((E,), jnp.int32).at[e_s].add(1)
+
+    xs = xt[tid_s]  # (k*T, D) sorted by expert
+    wi = params["experts"]["wi"].astype(x.dtype)
+    wo = params["experts"]["wo"].astype(x.dtype)
+    h = jax.lax.ragged_dot(xs, wi, group_sizes).astype(x.dtype)
+    h = h + params["experts"]["bi"].astype(x.dtype)[e_s]
+    h = act(h)
+    eo = jax.lax.ragged_dot(h, wo, group_sizes).astype(x.dtype)
+    eo = eo + params["experts"]["bo"].astype(x.dtype)[e_s]
+
+    yt = jnp.zeros((T, D), x.dtype).at[tid_s].add(
+        eo * gate_s.astype(x.dtype)[:, None])
+    y = yt.reshape(B, S, D)
+    y = _constrain(y, mesh, P(DATA_AXIS, SEQ_AXIS, None))
+
+    aux = {
+        "aux_loss": load_balancing_loss(
+            jnp.mean(probs, axis=0),
+            jnp.zeros(E, jnp.float32).at[expert_idx[:, 0]].add(1.0) / T, E),
+        "z_loss": router_z_loss(logits),
+        "dropped_frac": jnp.float32(0.0),  # dropless by construction
+    }
+    return y, aux
+
+
 def moe_ffn(params, x, cfg: MoEConfig, mesh=None, activation=None):
     """Drop-in MoE replacement for a dense FFN block.
 
@@ -216,6 +260,16 @@ def moe_ffn(params, x, cfg: MoEConfig, mesh=None, activation=None):
     # scales with top_k, else top-2 structurally drops second choices)
     capacity = max(1, math.ceil(k * T / E * cfg.capacity_factor))
     impl = cfg.resolved_dispatch_impl()
+
+    if impl == "dropless":
+        if (mesh is not None and EXPERT_AXIS in mesh.axis_names
+                and mesh.shape[EXPERT_AXIS] > 1):
+            raise ValueError(
+                "dispatch_impl='dropless' does not compose with expert "
+                "parallelism yet (ragged groups cannot ride the 'expert' "
+                "mesh axis); use 'sorted' or 'dense'"
+            )
+        return _moe_ffn_dropless(params, x, cfg, act, logits, mesh)
 
     if impl == "sorted":
         probs, expert_idx, gate = router_topk(logits, k, cfg.normalize_gates)

@@ -726,17 +726,30 @@ class Engine(ConfigAccessorsMixin):
         if own is not None and self._offload.chunks_match(own):
             return own
 
+        # topology changed (or own file missing): merge every rank file
+        # present on disk (gap-tolerant — discovered by listing, not by
+        # scanning until the first hole), bounded by the process count
+        # recorded at save time so stale files from an older, larger save
+        # into the same tag are ignored
+        import re
+
         saved_procs = int(model_states.get("process_count", 0))
-        merged = optim_states.get("offload")
-        merged = dict(merged) if merged else None
-        rank = 1
-        while (rank < saved_procs) if saved_procs else ck.exists(
-                optim_state_filename(rank)):
-            rf = optim_state_filename(rank)
-            rank += 1
-            if not ck.exists(rf):
-                continue
-            rank_sd = ck.load(rf).get("offload")
+        ranks = sorted(
+            int(m.group(1))
+            for f in os.listdir(ck.ckpt_dir)
+            if (m := re.match(r"zero_pp_rank_(\d+)_mp_rank_\d+_optim_states",
+                              f))
+        )
+        if saved_procs:
+            ranks = [r for r in ranks if r < saved_procs]
+        merged = None
+        for r in ranks:
+            if jax.process_count() > 1 and r == jax.process_index():
+                rank_sd = own  # already loaded above
+            elif r == 0:
+                rank_sd = optim_states.get("offload")  # the main file
+            else:
+                rank_sd = ck.load(optim_state_filename(r)).get("offload")
             if not rank_sd:
                 continue
             if merged is None:
@@ -1468,6 +1481,16 @@ class Engine(ConfigAccessorsMixin):
                     params=self._offload_reshard_fn()(fresh),
                     step=jnp.asarray(optim_states["step"], jnp.int32),
                 )
+            elif self._offload is not None:
+                # no usable offload state: the host masters still hold the
+                # INIT-time params and would revert the restored weights on
+                # the next step — push the checkpoint params into them
+                self._offload.set_master_params(
+                    self._to_master_sharded(state.params))
+                logger.warning(
+                    "checkpoint carried no matching offload state: params "
+                    "pushed into host masters, optimizer moments reset"
+                )
             elif state.master is not None and optim_states.get("master"):
                 master = jax.tree.map(
                     lambda x, s: jax.device_put(
@@ -1477,11 +1500,17 @@ class Engine(ConfigAccessorsMixin):
                     self.master_specs,
                 )
                 state = state._replace(master=master)
-            opt_state = jax.tree.map(
-                lambda x, ref: jax.device_put(jnp.asarray(x, ref.dtype), ref.sharding),
-                _retree(optim_states["opt_state"], self.state.opt_state),
-                self.state.opt_state,
-            )
+            if self._offload is None:
+                # device opt_state restore — for offload engines the host
+                # chunks are the source of truth and the device opt_state
+                # is (), which a non-offload checkpoint cannot populate
+                opt_state = jax.tree.map(
+                    lambda x, ref: jax.device_put(
+                        jnp.asarray(x, ref.dtype), ref.sharding),
+                    _retree(optim_states["opt_state"], self.state.opt_state),
+                    self.state.opt_state,
+                )
+                state = state._replace(opt_state=opt_state)
             sc = optim_states["scaler"]
             scaler = LossScaleState(
                 loss_scale=jnp.asarray(sc["loss_scale"], jnp.float32),
@@ -1489,7 +1518,6 @@ class Engine(ConfigAccessorsMixin):
                 hysteresis=jnp.asarray(sc["hysteresis"], jnp.int32),
             )
             state = state._replace(
-                opt_state=opt_state,
                 scaler=scaler,
                 step=jnp.asarray(optim_states["step"], jnp.int32),
             )

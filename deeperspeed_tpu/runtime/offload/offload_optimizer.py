@@ -59,6 +59,15 @@ def _index_key(index) -> str:
     return "-".join(str(sl.start or 0) for sl in index)
 
 
+def _key_starts(key: str):
+    """Inverse of _index_key: per-dimension slice starts."""
+    return [int(s) for s in key.split("-")] if key else []
+
+
+def _key_slices(key: str, cshape):
+    return tuple(slice(s, s + d) for s, d in zip(_key_starts(key), cshape))
+
+
 class HostOffloadOptimizer:
     """Owns the fp32 master copy + Adam moments off-device — one chunk per
     addressable master shard — and performs the optimizer step on the host
@@ -220,11 +229,11 @@ class HostOffloadOptimizer:
         meta = {}
         for i, name in enumerate(self.leaf_names):
             for key, cshape in self.chunk_shapes[i].items():
-                starts = [int(s) for s in key.split("-")] if key else []
                 meta[f"{name}@{key}"] = {
                     "leaf": name,
                     "leaf_shape": list(self.shapes[i]),
-                    "index": [[s, s + d] for s, d in zip(starts, cshape)],
+                    "index": [[sl.start, sl.stop]
+                              for sl in _key_slices(key, cshape)],
                 }
         return meta
 
@@ -341,8 +350,7 @@ class HostOffloadOptimizer:
 
         for i, name in enumerate(self.leaf_names):
             for key, cshape in self.chunk_shapes[i].items():
-                starts = [int(s) for s in key.split("-")] if key else []
-                sl = tuple(slice(s, s + d) for s, d in zip(starts, cshape))
+                sl = _key_slices(key, cshape)
                 cname = f"{name}@{key}"
                 chunk = {k: np.ascontiguousarray(full[name][k][sl].ravel())
                          for k in self._STATE_KEYS}

@@ -308,3 +308,78 @@ class TestMoEGeneration:
         out = make_generator(cfg)(params, prompt, max_new_tokens=5)
         assert out.shape == (1, 8)
         assert np.all(np.asarray(out) >= 0)
+
+
+class TestDroplessDispatch:
+    """MegaBlocks-style ragged_dot dispatch: no capacity, no drops — at a
+    capacity factor high enough that nothing drops, it must match the
+    dense path exactly."""
+
+    def _setup(self, E=4, k=2, T=64, seed=0):
+        D, F = 16, 32
+        params = init_moe_params(
+            jax.random.PRNGKey(seed), D, F,
+            MoEConfig(num_experts=E, top_k=k))
+        x = jax.random.normal(jax.random.PRNGKey(seed + 1), (4, T // 4, D),
+                              jnp.float32)
+        return params, x
+
+    def test_matches_dense_when_nothing_drops(self):
+        params, x = self._setup()
+        # cf=E: per-expert capacity k*T >= every assignment -> no drops
+        dense_cfg = MoEConfig(num_experts=4, top_k=2, capacity_factor=4.0,
+                              dispatch_impl="dense")
+        drop_cfg = MoEConfig(num_experts=4, top_k=2,
+                             dispatch_impl="dropless")
+        y_d, aux_d = moe_ffn(params, x, dense_cfg)
+        y_x, aux_x = moe_ffn(params, x, drop_cfg)
+        assert float(aux_d["dropped_frac"]) == 0.0
+        assert float(aux_x["dropped_frac"]) == 0.0
+        np.testing.assert_allclose(np.asarray(y_d), np.asarray(y_x),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_grads_match_dense(self):
+        params, x = self._setup(seed=3)
+        dense_cfg = MoEConfig(num_experts=4, top_k=2, capacity_factor=4.0,
+                              dispatch_impl="dense")
+        drop_cfg = MoEConfig(num_experts=4, top_k=2,
+                             dispatch_impl="dropless")
+
+        def loss(p, cfg):
+            y, aux = moe_ffn(p, x, cfg)
+            return jnp.sum(y**2) + moe_mod.moe_loss(aux, cfg)
+
+        g_d = jax.grad(loss)(params, dense_cfg)
+        g_x = jax.grad(loss)(params, drop_cfg)
+        for a, b in zip(jax.tree.leaves(g_d), jax.tree.leaves(g_x)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-5)
+
+    def test_skewed_routing_processes_all_tokens(self):
+        """Force every token onto ONE expert: capacity-based paths would
+        drop most assignments; dropless must process them all."""
+        D, F, E = 16, 32, 4
+        cfg = MoEConfig(num_experts=E, top_k=1, dispatch_impl="dropless")
+        params = init_moe_params(jax.random.PRNGKey(0), D, F, cfg)
+        # router forced to expert 2: positive inputs + a large positive
+        # column make logit_2 = 10 * sum(x) dominate for every token (the
+        # linear router has no bias, so x must keep a positive sum)
+        params["router"]["wg"] = jnp.zeros((D, E)).at[:, 2].set(10.0)
+        x = 0.05 + 0.1 * jnp.abs(
+            jax.random.normal(jax.random.PRNGKey(1), (2, 16, D), jnp.float32))
+        y, aux = moe_ffn(params, x, cfg)
+        assert float(aux["dropped_frac"]) == 0.0
+        # equivalent dense computation through expert 2 with gate ~1
+        wi, bi = params["experts"]["wi"][2], params["experts"]["bi"][2]
+        wo, bo = params["experts"]["wo"][2], params["experts"]["bo"][2]
+        ref = (jax.nn.gelu(x @ wi + bi, approximate=True) @ wo + bo)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                                   rtol=2e-3, atol=2e-3)
+
+    def test_rejects_expert_parallel_mesh(self):
+        cfg = MoEConfig(num_experts=4, top_k=2, dispatch_impl="dropless")
+        params = init_moe_params(jax.random.PRNGKey(0), 16, 32, cfg)
+        x = jnp.zeros((2, 8, 16), jnp.float32)
+        mesh = build_mesh({"data": 2, "expert": 4})
+        with pytest.raises(ValueError, match="dropless"):
+            moe_ffn(params, x, cfg, mesh=mesh)

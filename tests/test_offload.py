@@ -441,3 +441,25 @@ class TestUniversalOffloadCheckpoint:
         dst = self._engine_on(4, tmp_path)
         with pytest.raises(ValueError, match="covered|absent"):
             dst._offload.load_state_dict(sd)
+
+
+def test_load_plain_checkpoint_into_offload_engine(tmp_path):
+    """A checkpoint saved WITHOUT offload restores into an offload engine:
+    the restored params must be pushed into the host masters (else the
+    first step would reassemble params from the init-time masters and
+    silently revert the restore)."""
+    plain = _make_engine()
+    for i in range(4):
+        plain.train_batch(_batch(plain, seed=i))
+    plain.save_checkpoint(str(tmp_path / "ck"), tag="p")
+    trained = [np.asarray(l) for l in jax.tree.leaves(plain.state.params)]
+
+    off = _make_engine(offload_device="cpu")
+    init_params = [np.asarray(l) for l in jax.tree.leaves(off.state.params)]
+    off.load_checkpoint(str(tmp_path / "ck"), tag="p")
+    off.train_batch(_batch(off, seed=99))  # must not revert to init
+    after = [np.asarray(l) for l in jax.tree.leaves(off.state.params)]
+    for a, t, i0 in zip(after, trained, init_params):
+        # one step away from the TRAINED weights, far from the init ones
+        assert np.abs(a - t).max() < np.abs(a - i0).max(), (
+            np.abs(a - t).max(), np.abs(a - i0).max())
