@@ -96,6 +96,35 @@ def main():
            jax.jit(lambda q=q: jax.grad(
                lambda q: (fn(q, q, q).astype(jnp.float32) ** 2).sum())(q)))
 
+    # flat-LUT edge cases the width-LUT never hit: EMPTY block rows (dummy
+    # invalid groups must flush ZERO outputs — asserted, not just finite),
+    # an empty key COLUMN (empty row of the transposed dk/dv LUT), and
+    # fully-skewed row/column runs
+    layout = np.zeros((2, 16, 16), np.int64)
+    layout[:, 0, :] = 1        # row 0 attends everything
+    layout[:, :, 0] = 1        # everyone attends col 0
+    layout[:, 7, :] = 0        # row 7 attends nothing
+    layout[0, 7, 0] = 1        # ...except head 0
+    layout[1, 0, 5] = 0        # head 1: col 5 has NO attending queries
+    fn = make_block_sparse_attention(layout, 128, causal=False)
+    q = jax.random.normal(jax.random.PRNGKey(6), (1, 2048, 2, 64),
+                          jnp.bfloat16)
+
+    def skewed_check(q=q, fn=fn):
+        out = fn(q, q, q)
+        # head 1 row-block 7 attends nothing: its output must be EXACT
+        # zeros (stale-VMEM garbage would be finite and slip a checksum)
+        empty = out[:, 7 * 128:8 * 128, 1, :].astype(jnp.float32)
+        zero_ok = jnp.sum(jnp.abs(empty)) == 0.0
+        grads = jax.grad(
+            lambda q: (fn(q, q, q).astype(jnp.float32) ** 2).sum())(q)
+        # poison the checksum iff the empty block was non-zero (a bare
+        # multiply would NaN unconditionally: 0 * nan == nan)
+        return grads.astype(jnp.float32) + jnp.where(zero_ok, 0.0, jnp.nan)
+
+    _check("sparse skewed+empty rows/cols fwd+bwd",
+           jax.jit(skewed_check))
+
     # ---- fused transformer layer -------------------------------------- #
     from deeperspeed_tpu.ops.transformer import (
         DeepSpeedTransformerConfig, DeepSpeedTransformerLayer)
