@@ -131,14 +131,22 @@ def apply_with_cache(cfg: GPTConfig, params, tokens, cache, offset):
     return logits, {"k": k_new, "v": v_new}
 
 
-def _select_next(logits, temperature, top_k, rng):
-    """logits (B, V) -> next token (B,). temperature<=0 = greedy."""
-    if temperature <= 0.0:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+def prep_sampling_logits(logits, temperature, top_k):
+    """Shared sampling transform: fp32 temperature divide + top-k filter.
+    One implementation serves make_generator AND the speculative decoder
+    (whose draft/target distributions must be filtered identically)."""
     logits = logits.astype(jnp.float32) / temperature
     if top_k is not None:
         kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < kth, -1e30, logits)
+    return logits
+
+
+def _select_next(logits, temperature, top_k, rng):
+    """logits (B, V) -> next token (B,). temperature<=0 = greedy."""
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = prep_sampling_logits(logits, temperature, top_k)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 

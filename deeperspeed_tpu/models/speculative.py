@@ -25,35 +25,62 @@ output buffer, offsets advance by the accepted length). Stale KV-cache
 entries beyond the rolled-back offset need no cleanup: the attention mask
 is offset-derived, so they are invisible until overwritten.
 
+``temperature > 0`` runs standard speculative SAMPLING (Leviathan et
+al.): accept draft token d with probability min(1, p_t(d)/p_d(d)); on
+rejection, sample the replacement from norm(max(p_t - p_d, 0)) with a
+key independent of the rejected draw. Sampling keys fold per OUTPUT
+POSITION, so a perfect draft reproduces plain ancestral sampling of the
+target exactly.
+
 Usage::
 
     gen = make_speculative_generator(target_cfg, draft_cfg, k_draft=4)
     out = gen(target_params, draft_params, prompt, max_new_tokens=64)
+    out = gen(target_params, draft_params, prompt, max_new_tokens=64,
+              temperature=0.9, top_k=40, rng=key)
 
 Batch size 1 (the speculative serving case; per-row accept counts would
 need per-row cache offsets).
 """
 
 from functools import partial
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
 
-from .generation import apply_with_cache, init_cache
+from .generation import apply_with_cache, init_cache, prep_sampling_logits
 from .gpt import GPTConfig
+
+# one transform for draft AND target (and make_generator): identical
+# temperature/top-k filtering is what the acceptance ratio assumes
+_prep_logits = prep_sampling_logits
+
+
+def _pos_key(rng, pos):
+    """Per-absolute-position sampling key: deterministic in the position,
+    independent of HOW decoding reached it — this is what makes
+    speculative sampling with draft == target reproduce plain ancestral
+    sampling exactly (same key at the same position -> same draw)."""
+    return jax.random.fold_in(rng, pos)
 
 
 def make_speculative_generator(target_cfg: GPTConfig, draft_cfg: GPTConfig,
                                k_draft: int = 4):
     """Build a jitted speculative generate(target_params, draft_params,
-    prompt, max_new_tokens) -> (B, S+max_new_tokens) tokens (greedy)."""
+    prompt, max_new_tokens, temperature=0.0, top_k=None, rng=None)
+    -> (B, S+max_new_tokens) tokens. temperature<=0 = greedy (bit-parity
+    with plain greedy target decoding); >0 = rejection sampling."""
     assert target_cfg.vocab_size == draft_cfg.vocab_size, (
         "target and draft must share a vocabulary")
     K = int(k_draft)
     assert K >= 1
 
-    @partial(jax.jit, static_argnames=("max_new_tokens",))
-    def generate(target_params, draft_params, prompt, max_new_tokens: int):
+    @partial(jax.jit,
+             static_argnames=("max_new_tokens", "temperature", "top_k"))
+    def generate(target_params, draft_params, prompt, max_new_tokens: int,
+                 temperature: float = 0.0, top_k: Optional[int] = None,
+                 rng=None):
         B, S = prompt.shape
         if B != 1:
             raise ValueError(
@@ -69,6 +96,15 @@ def make_speculative_generator(target_cfg: GPTConfig, draft_cfg: GPTConfig,
                 raise ValueError(
                     f"prompt ({S}) + max_new_tokens ({max_new_tokens}) + "
                     f"draft slack ({K + 1}) exceeds max_seq ({cfg.max_seq})")
+        sampling = temperature > 0.0
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        # three independent streams: proposal/bonus draws, acceptance
+        # uniforms, and rejection replacements. The replacement MUST NOT
+        # reuse the proposal key: categorical with the same key replays
+        # the same Gumbel vector, conditioning the replacement on the
+        # rejected token and skewing it away from norm(max(p_t - p_d, 0)).
+        rng_tok, rng_acc, rng_fix = jax.random.split(rng, 3)
 
         t_cache = init_cache(target_cfg, B, max_len)
         d_cache = init_cache(draft_cfg, B, max_len)
@@ -76,7 +112,13 @@ def make_speculative_generator(target_cfg: GPTConfig, draft_cfg: GPTConfig,
             target_cfg, target_params, prompt, t_cache, 0)
         _, d_cache = apply_with_cache(
             draft_cfg, draft_params, prompt, d_cache, 0)
-        first = jnp.argmax(t_logits[:, -1], axis=-1).astype(jnp.int32)  # (B,)
+        if sampling:
+            first = jax.random.categorical(
+                _pos_key(rng_tok, 0),
+                _prep_logits(t_logits[:, -1], temperature, top_k),
+                axis=-1).astype(jnp.int32)
+        else:
+            first = jnp.argmax(t_logits[:, -1], axis=-1).astype(jnp.int32)
 
         out = jnp.zeros((B, max_new_tokens + K + 1), jnp.int32)
         out = jax.lax.dynamic_update_slice(out, first[:, None], (0, 0))
@@ -98,28 +140,73 @@ def make_speculative_generator(target_cfg: GPTConfig, draft_cfg: GPTConfig,
                 tok, cache = carry
                 logits, cache = apply_with_cache(
                     draft_cfg, draft_params, tok[:, None], cache, offset + j)
-                nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
-                return (nxt, cache), nxt
+                row = logits[:, -1]
+                if sampling:
+                    # the PER-OUTPUT-POSITION key: a token proposed for
+                    # output index n+j draws with the same key ancestral
+                    # sampling would use there, so draft == target
+                    # reproduces plain sampling exactly
+                    nxt = jax.random.categorical(
+                        _pos_key(rng_tok, n + j),
+                        _prep_logits(row, temperature, top_k),
+                        axis=-1).astype(jnp.int32)
+                else:
+                    nxt = jnp.argmax(row, axis=-1).astype(jnp.int32)
+                return (nxt, cache), (nxt, row[0])
 
-            (_, d_cache), drafts = jax.lax.scan(
+            (_, d_cache), (drafts_all, d_rows) = jax.lax.scan(
                 draft_step, (last, d_cache), jnp.arange(K + 1))
-            drafts = drafts[:K, 0]  # (K,) proposed tokens d_1..d_K
+            drafts = drafts_all[:K, 0]  # (K,) proposed tokens d_1..d_K
 
             # --- verify phase: one target forward over [last, d_1..d_K] ---
             block = jnp.concatenate([last, drafts], axis=0)[None]  # (1, K+1)
             t_logits, t_cache = apply_with_cache(
                 target_cfg, target_params, block, t_cache, offset)
-            t_preds = jnp.argmax(t_logits[0], axis=-1).astype(jnp.int32)
-            # t_preds[j] = target's token after consuming block[:j+1]
 
-            # --- acceptance: longest prefix where draft == target ---
-            matches = (drafts == t_preds[:K]).astype(jnp.int32)
-            n_acc = jnp.sum(jnp.cumprod(matches))  # 0..K
-
-            # emitted this round: accepted drafts then the target's token
-            # at the first mismatch (or bonus token on full acceptance)
             idx = jnp.arange(K + 1, dtype=jnp.int32)
-            bonus = t_preds[n_acc]
+            if sampling:
+                # Leviathan et al. rejection rule: accept d_{j+1} with
+                # prob min(1, p_t/p_d); on first rejection sample the
+                # replacement from norm(max(p_t - p_d, 0)). Padding p_d
+                # with a zero row makes the full-acceptance bonus draw
+                # come from p_t[K] through the same expression.
+                p_t = jax.nn.softmax(
+                    _prep_logits(t_logits[0], temperature, top_k), axis=-1)
+                p_d = jax.nn.softmax(
+                    _prep_logits(d_rows[:K], temperature, top_k), axis=-1)
+                ratio = (p_t[jnp.arange(K), drafts]
+                         / (p_d[jnp.arange(K), drafts] + 1e-20))
+                u = jax.vmap(
+                    lambda j: jax.random.uniform(_pos_key(rng_acc, n + j))
+                )(jnp.arange(K))
+                accept = (u <= ratio).astype(jnp.int32)
+                n_acc = jnp.sum(jnp.cumprod(accept))
+                p_d_pad = jnp.concatenate(
+                    [p_d, jnp.zeros((1,) + p_d.shape[1:], p_d.dtype)], axis=0)
+                resid = jnp.clip(p_t[n_acc] - p_d_pad[n_acc], 0.0)
+                total = jnp.sum(resid)
+                q = jnp.where(total > 0, resid / jnp.maximum(total, 1e-20),
+                              p_t[n_acc])
+                # full acceptance (n_acc == K): the bonus comes from p_t[K]
+                # and must use the POSITIONAL token key so a perfect draft
+                # reproduces ancestral sampling. A rejection replacement
+                # needs a key INDEPENDENT of the rejected proposal's draw.
+                bonus_key = jnp.where(
+                    n_acc == K,
+                    _pos_key(rng_tok, n + n_acc),
+                    _pos_key(rng_fix, n + n_acc),
+                )
+                bonus = jax.random.categorical(
+                    bonus_key, jnp.log(q + 1e-20)).astype(jnp.int32)
+            else:
+                t_preds = jnp.argmax(t_logits[0], axis=-1).astype(jnp.int32)
+                # t_preds[j] = target's token after consuming block[:j+1]
+                matches = (drafts == t_preds[:K]).astype(jnp.int32)
+                n_acc = jnp.sum(jnp.cumprod(matches))  # 0..K
+                bonus = t_preds[n_acc]
+
+            # emitted this round: accepted drafts then the replacement /
+            # bonus token at the first mismatch (or after full acceptance)
             emitted = jnp.where(idx < n_acc, jnp.append(drafts, 0), bonus)
             # positions >= n_acc+1 hold `bonus` copies: they are either
             # overwritten by the next round's write at n + n_acc + 1 or

@@ -95,3 +95,63 @@ def test_gqa_draft_composes(models):
     spec = make_speculative_generator(tcfg, dcfg, k_draft=3)(
         tparams, dparams, prompt, max_new_tokens=9)
     np.testing.assert_array_equal(np.asarray(ref), np.asarray(spec))
+
+
+class TestSamplingAcceptance:
+    """temperature > 0: Leviathan-style rejection sampling. Sampling keys
+    are folded per OUTPUT POSITION, so with draft == target every
+    proposal is accepted (ratio == 1) and the output equals plain
+    ancestral sampling of the target with the same positional keys."""
+
+    def _reference_sampling(self, cfg, params, prompt, max_new, temperature,
+                            rng):
+        """Plain ancestral sampling with the positional-key discipline."""
+        from deeperspeed_tpu.models.generation import (
+            apply_with_cache, init_cache)
+        from deeperspeed_tpu.models.speculative import (
+            _pos_key, _prep_logits)
+
+        rng_tok, _ = jax.random.split(rng)
+        B, S = prompt.shape
+        cache = init_cache(cfg, B, S + max_new)
+        logits, cache = apply_with_cache(cfg, params, prompt, cache, 0)
+        toks = []
+        tok = jax.random.categorical(
+            _pos_key(rng_tok, 0),
+            _prep_logits(logits[:, -1], temperature, None),
+            axis=-1).astype(jnp.int32)
+        toks.append(tok)
+        for m in range(1, max_new):
+            logits, cache = apply_with_cache(
+                cfg, params, tok[:, None], cache, S + m - 1)
+            tok = jax.random.categorical(
+                _pos_key(rng_tok, m),
+                _prep_logits(logits[:, -1], temperature, None),
+                axis=-1).astype(jnp.int32)
+            toks.append(tok)
+        return jnp.concatenate([prompt, jnp.stack(toks, axis=1)], axis=1)
+
+    def test_perfect_draft_matches_ancestral_sampling(self, models):
+        tcfg, tparams, _, _ = models
+        prompt = jnp.asarray([[3, 1, 4]], jnp.int32)
+        rng = jax.random.PRNGKey(42)
+        ref = self._reference_sampling(tcfg, tparams, prompt, 15, 0.9, rng)
+        spec = make_speculative_generator(tcfg, tcfg, k_draft=3)(
+            tparams, tparams, prompt, max_new_tokens=15,
+            temperature=0.9, rng=rng)
+        np.testing.assert_array_equal(np.asarray(ref), np.asarray(spec))
+
+    def test_weak_draft_samples_valid_tokens(self, models):
+        tcfg, tparams, dcfg, dparams = models
+        prompt = jnp.asarray([[7, 7]], jnp.int32)
+        out = make_speculative_generator(tcfg, dcfg, k_draft=4)(
+            tparams, dparams, prompt, max_new_tokens=20,
+            temperature=1.0, top_k=20, rng=jax.random.PRNGKey(5))
+        arr = np.asarray(out)
+        assert arr.shape == (1, 22)
+        assert (arr >= 0).all() and (arr < tcfg.vocab_size).all()
+        # different seeds give different continuations (it is sampling)
+        out2 = make_speculative_generator(tcfg, dcfg, k_draft=4)(
+            tparams, dparams, prompt, max_new_tokens=20,
+            temperature=1.0, top_k=20, rng=jax.random.PRNGKey(6))
+        assert not np.array_equal(arr, np.asarray(out2))
