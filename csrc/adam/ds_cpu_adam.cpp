@@ -153,6 +153,142 @@ int adam_step_impl(int optimizer_id, int64_t step, float lr, float beta1_overrid
     return 0;
 }
 
+// ------------------------------------------------------------------ //
+// Streamed-offload wire codec: fused dequant(grads) -> Adam -> quant(delta)
+// for the quantized host<->device offload channel
+// (deeperspeed_tpu/runtime/offload/streaming.py). One cache-friendly pass
+// per wire block replaces ~10 numpy passes over multi-GB arrays on the
+// single-core host.
+//
+// Wire layout (must match streaming._dev_quant / _dev_dequant): per leaf,
+// the flat vector is zero-padded to nb*block elements. int8: one byte per
+// element. int4: HALF-SPLIT nibbles — byte i carries element i (low) and
+// element half+i (high), half = nb*block/2. Scales: nb floats per leaf,
+// absmax/qmax per block. The uplink carries the delta (master - shadow)
+// quantized round-to-nearest; the bf16 shadow then replays the exact
+// dequantized delta, which is what makes the quantization residual carry
+// into the next step (error feedback) instead of being lost.
+// ------------------------------------------------------------------ //
+
+inline float bf16_to_f32(uint16_t b) {
+    uint32_t x = ((uint32_t)b) << 16;
+    float f;
+    memcpy(&f, &x, 4);
+    return f;
+}
+
+inline int fetch_q(const unsigned char* packed, int64_t e, int bits,
+                   int64_t half) {
+    if (bits == 8) return (int)(int8_t)packed[e];
+    unsigned char byte = (e < half) ? packed[e] : packed[e - half];
+    int v = (e < half) ? (byte & 0x0F) : (byte >> 4);
+    return v >= 8 ? v - 16 : v;
+}
+
+inline void adam_block(float* p, const float* g, float* m, float* v,
+                       int64_t count, const AdamConfig& c, float step_size,
+                       float bc2_sqrt, float lr) {
+    int64_t i = 0;
+#if defined(__AVX512F__) || defined(__AVX2__)
+    for (; i + kSimd <= count; i += kSimd)
+        adam_simd(p, g, m, v, i, c, step_size, bc2_sqrt, lr);
+#endif
+    for (; i < count; ++i)
+        adam_scalar(p[i], g[i], m[i], v[i], c, step_size, bc2_sqrt, lr);
+}
+
+int stream_chunk_step_impl(int optimizer_id, int64_t step, float lr,
+                           const unsigned char* g_packed,
+                           const float* g_scales, float* master,
+                           float* exp_avg, float* exp_avg_sq,
+                           uint16_t* shadow, unsigned char* out_packed,
+                           float* out_scales, const int64_t* leaf_sizes,
+                           const int* leaf_bits, int64_t n_leaves,
+                           int block) {
+    AdamConfig c;
+    {
+        std::lock_guard<std::mutex> g(g_mu);
+        auto it = g_optimizers.find(optimizer_id);
+        if (it == g_optimizers.end()) return -1;
+        c = it->second;
+    }
+    const float bc1 = c.bias_correction ? 1.f - powf(c.beta1, (float)step) : 1.f;
+    const float bc2_sqrt =
+        c.bias_correction ? sqrtf(1.f - powf(c.beta2, (float)step)) : 1.f;
+    const float step_size = lr / bc1;
+
+    // validate the whole wire BEFORE touching any state: a mid-loop
+    // rejection would leave earlier leaves already stepped, and the
+    // caller's numpy fallback would then double-apply them
+    for (int64_t li = 0; li < n_leaves; ++li)
+        if (leaf_bits[li] != 4 && leaf_bits[li] != 8)
+            return -2;  // bf16/fp32 wires stay on the python path
+
+    float* gbuf = new float[block];
+    float* dbuf = new float[block];
+    int64_t elem_off = 0, byte_off = 0, scale_off = 0;
+    for (int64_t li = 0; li < n_leaves; ++li) {
+        const int64_t n = leaf_sizes[li];
+        const int bits = leaf_bits[li];
+        const int64_t nb = (n + block - 1) / block;
+        const int64_t padded = nb * block;
+        const int64_t half = padded / 2;  // int4 half-split boundary
+        const int64_t leaf_bytes = bits == 4 ? padded / 2 : padded;
+        const unsigned char* gp = g_packed + byte_off;
+        unsigned char* op = out_packed + byte_off;
+        const float qmax = bits == 4 ? 7.f : 127.f;
+        memset(op, 0, (size_t)leaf_bytes);
+        float* mast = master + elem_off;
+        float* ma = exp_avg + elem_off;
+        float* va = exp_avg_sq + elem_off;
+        uint16_t* sh = shadow + elem_off;
+        for (int64_t b = 0; b < nb; ++b) {
+            const int64_t e0 = b * block;
+            const int64_t count = (e0 + block <= n) ? block : (n - e0);
+            if (count <= 0) {  // pure padding block: zero delta, unit scale
+                out_scales[scale_off + b] = 1.f;
+                continue;
+            }
+            const float gs = g_scales[scale_off + b];
+            for (int64_t j = 0; j < count; ++j)
+                gbuf[j] = fetch_q(gp, e0 + j, bits, half) * gs;
+            adam_block(mast + e0, gbuf, ma + e0, va + e0, count, c,
+                       step_size, bc2_sqrt, lr);
+            float absmax = 0.f;
+            for (int64_t j = 0; j < count; ++j) {
+                float d = mast[e0 + j] - bf16_to_f32(sh[e0 + j]);
+                dbuf[j] = d;
+                float a = fabsf(d);
+                if (a > absmax) absmax = a;
+            }
+            float s = absmax > 0.f ? absmax / qmax : 1.f;
+            out_scales[scale_off + b] = s;
+            const float inv_s = 1.f / s;
+            for (int64_t j = 0; j < count; ++j) {
+                const int64_t e = e0 + j;
+                float q = nearbyintf(dbuf[j] * inv_s);  // matches np.rint
+                if (q > qmax) q = qmax;
+                if (q < -qmax - 1) q = -qmax - 1;
+                const int qi = (int)q;
+                if (bits == 8) {
+                    op[e] = (unsigned char)(int8_t)qi;
+                } else if (e < half) {
+                    op[e] |= (unsigned char)(qi & 0x0F);
+                } else {
+                    op[e - half] |= (unsigned char)((qi & 0x0F) << 4);
+                }
+                sh[e] = f32_to_bf16(bf16_to_f32(sh[e]) + q * s);
+            }
+        }
+        elem_off += n;
+        byte_off += leaf_bytes;
+        scale_off += nb;
+    }
+    delete[] gbuf;
+    delete[] dbuf;
+    return 0;
+}
+
 }  // namespace
 
 extern "C" {
@@ -188,6 +324,25 @@ int ds_adam_step_copy_bf16(int optimizer_id, long long step, float lr, float bet
     return adam_step_impl(optimizer_id, step, lr, beta1, beta2, eps, weight_decay,
                           params, grads, exp_avg, exp_avg_sq, n,
                           (uint16_t*)bf16_params);
+}
+
+// Fused streamed-offload chunk step: dequantize the int4/int8 wire grads,
+// Adam-update the fp32 master/moments, quantize the (error-fed) param delta
+// against the bf16 shadow, and advance the shadow — one pass per wire
+// block. Buffers are the CONCATENATED per-leaf wire layout described above;
+// leaf_sizes/leaf_bits give the per-leaf geometry. Returns 0; -1 unknown
+// optimizer id; -2 unsupported per-leaf wire bits.
+int ds_stream_chunk_step(int optimizer_id, long long step, float lr,
+                         const unsigned char* g_packed, const float* g_scales,
+                         float* master, float* exp_avg, float* exp_avg_sq,
+                         unsigned short* shadow, unsigned char* out_packed,
+                         float* out_scales, const long long* leaf_sizes,
+                         const int* leaf_bits, long long n_leaves, int block) {
+    return stream_chunk_step_impl(optimizer_id, step, lr, g_packed, g_scales,
+                                  master, exp_avg, exp_avg_sq,
+                                  (uint16_t*)shadow, out_packed, out_scales,
+                                  (const int64_t*)leaf_sizes, leaf_bits,
+                                  n_leaves, block);
 }
 
 // Introspection for ds_report.

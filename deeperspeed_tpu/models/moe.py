@@ -226,9 +226,12 @@ def _moe_ffn_dropless(params, x, cfg: MoEConfig, act, logits, mesh):
     eo = jax.lax.ragged_dot(h, wo, group_sizes).astype(x.dtype)
     eo = eo + params["experts"]["bo"].astype(x.dtype)[e_s]
 
-    yt = jnp.zeros((T, D), x.dtype).at[tid_s].add(
-        eo * gate_s.astype(x.dtype)[:, None])
-    y = yt.reshape(B, S, D)
+    # combine accumulates k expert outputs per token in fp32 (the dense
+    # path's combine einsum accumulates fp32 on the MXU; a bf16 scatter
+    # here would make the impls numerically different, not just faster)
+    yt = jnp.zeros((T, D), jnp.float32).at[tid_s].add(
+        (eo * gate_s.astype(x.dtype)[:, None]).astype(jnp.float32))
+    y = yt.astype(x.dtype).reshape(B, S, D)
     y = _constrain(y, mesh, P(DATA_AXIS, SEQ_AXIS, None))
 
     aux = {
@@ -310,9 +313,13 @@ def moe_ffn(params, x, cfg: MoEConfig, mesh=None, activation=None):
     if impl == "sorted":
         eo_flat = eo.reshape(E * capacity, D)
         w_s = (gate_s * keep_s).astype(x.dtype)[:, None]
-        yt = jnp.zeros((T, D), x.dtype).at[tid_s].add(eo_flat[slot_s] * w_s)
+        # fp32 combine accumulator, matching the dense path's fp32 MXU
+        # accumulation (see the dropless combine above)
+        yt = jnp.zeros((T, D), jnp.float32).at[tid_s].add(
+            (eo_flat[slot_s] * w_s).astype(jnp.float32)).astype(x.dtype)
     else:
-        yt = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), eo)
+        yt = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), eo,
+                        preferred_element_type=jnp.float32).astype(x.dtype)
     y = yt.reshape(B, S, D)
     y = _constrain(y, mesh, P(DATA_AXIS, SEQ_AXIS, None))
 

@@ -169,6 +169,40 @@ class DeepSpeedCPUAdam(FusedAdam):
     def has_native(self) -> bool:
         return self._lib is not None
 
+    def step_stream_chunk(self, step, g_packed, g_scales, master, exp_avg,
+                          exp_avg_sq, shadow_u16, out_packed, out_scales,
+                          leaf_sizes, leaf_bits, block, lr=None) -> bool:
+        """Fused offload-wire step (csrc ds_stream_chunk_step): dequantize
+        int4/int8 wire grads, Adam the fp32 master chunk, quantize the
+        error-fed delta against the bf16 shadow and advance it — one native
+        pass. Returns False when the native op is unavailable or the wire
+        mixes unsupported per-leaf precisions (caller falls back to the
+        numpy path)."""
+        if self._lib is None:
+            return False
+        import ctypes
+
+        import numpy as _np
+
+        lr = self.lr if lr is None else float(lr)
+        ptr = lambda a, t: a.ctypes.data_as(ctypes.POINTER(t))
+        sizes = _np.ascontiguousarray(leaf_sizes, _np.int64)
+        bits = _np.ascontiguousarray(leaf_bits, _np.int32)
+        rc = self._lib.ds_stream_chunk_step(
+            self._opt_id, int(step), lr,
+            ptr(g_packed, ctypes.c_uint8), ptr(g_scales, ctypes.c_float),
+            ptr(master, ctypes.c_float), ptr(exp_avg, ctypes.c_float),
+            ptr(exp_avg_sq, ctypes.c_float),
+            ptr(shadow_u16, ctypes.c_uint16),
+            ptr(out_packed, ctypes.c_uint8), ptr(out_scales, ctypes.c_float),
+            ptr(sizes, ctypes.c_longlong), ptr(bits, ctypes.c_int),
+            len(sizes), int(block))
+        if rc == -2:
+            return False
+        if rc != 0:
+            raise RuntimeError("native stream_chunk_step failed")
+        return True
+
     def step_flat(self, step, params, grads, exp_avg, exp_avg_sq, lr=None,
                   bf16_out=None):
         """In-place Adam step on flat fp32 numpy arrays. `bf16_out` (uint16

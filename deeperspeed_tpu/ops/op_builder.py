@@ -186,6 +186,17 @@ class CPUAdamBuilder(OpBuilder):
             [fp, fp, fp, fp, c.c_longlong, c.POINTER(c.c_uint16)]
         lib.ds_adam_simd_width.restype = c.c_char_p
         lib.ds_adam_simd_width.argtypes = []
+        u8p = c.POINTER(c.c_uint8)
+        lib.ds_stream_chunk_step.restype = c.c_int
+        lib.ds_stream_chunk_step.argtypes = [
+            c.c_int, c.c_longlong, c.c_float,
+            u8p, fp,                      # wire grads: packed + scales
+            fp, fp, fp,                   # master, exp_avg, exp_avg_sq
+            c.POINTER(c.c_uint16),        # bf16 shadow bits
+            u8p, fp,                      # delta wire out: packed + scales
+            c.POINTER(c.c_longlong), c.POINTER(c.c_int),  # leaf geometry
+            c.c_longlong, c.c_int,        # n_leaves, block
+        ]
 
 
 ALL_OPS = {b.NAME: b for b in (AsyncIOBuilder(), CPUAdamBuilder())}

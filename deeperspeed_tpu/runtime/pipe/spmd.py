@@ -46,7 +46,7 @@ pipeline analog of ZeRO-1 ownership.
 """
 
 from functools import partial
-from typing import Callable
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -54,6 +54,10 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ...ops.ring_attention import _SHMAP_CHECK_KWARGS, shard_map
 from ...parallel.topology import DATA_AXIS, PIPE_AXIS
+
+# one-time notice when the 1f1b default is picked implicitly (its gradient
+# contract is subtle; see make_spmd_pipeline_train_step)
+_WARNED_IMPLICIT_1F1B = False
 
 
 def _opt_specs_like(opt_state, params, p_spec):
@@ -282,7 +286,7 @@ def make_spmd_pipeline_train_step(stage_fn: Callable, loss_fn: Callable,
                                   micro_batches: int, mesh: Mesh,
                                   remat: bool = True,
                                   param_specs=None,
-                                  schedule: str = "1f1b"):
+                                  schedule: Optional[str] = None):
     """Fully-fused pipelined train step — composes PP x DP x TP on one mesh.
 
     loss_fn(outputs, labels) -> scalar (outputs: (M, mb, ...)).
@@ -318,6 +322,24 @@ def make_spmd_pipeline_train_step(stage_fn: Callable, loss_fn: Callable,
         f"mesh '{PIPE_AXIS}' axis is {mesh.shape[PIPE_AXIS]}, "
         f"expected num_stages={num_stages}"
     )
+    if schedule is None:
+        # 1f1b is the right default for memory, but its gradients are only
+        # exact for losses that decompose as a per-microbatch MEAN (see the
+        # CONTRACT above). Surface that once when the caller didn't choose.
+        schedule = "1f1b"
+        global _WARNED_IMPLICIT_1F1B
+        if not _WARNED_IMPLICIT_1F1B:
+            _WARNED_IMPLICIT_1F1B = True
+            from ...utils.logging import logger
+
+            logger.warning(
+                "make_spmd_pipeline_train_step: defaulting to "
+                "schedule='1f1b', which assumes loss_fn decomposes as a "
+                "per-microbatch mean (sum-reduced or count-weighted losses "
+                "get silently rescaled gradients). Pass schedule='1f1b' "
+                "explicitly to acknowledge, or schedule='gpipe' for exact "
+                "gradients with any loss."
+            )
     assert schedule in ("1f1b", "gpipe"), f"unknown schedule {schedule!r}"
     data_parallel = DATA_AXIS in mesh.axis_names and mesh.shape[DATA_AXIS] > 1
     fwd_body = partial(_pipeline_body, stage_fn=stage_fn,

@@ -12,7 +12,7 @@ gate fails (exit 1) on a 2% regression.
 Configs: zero{0,1,2,3} with fp32 masters, plus masterless bf16 (the
 single-chip flagship mode). On one chip ZeRO shardings are degenerate
 (dp=1) but still exercise each stage's spec/code path; the sharded-mesh
-equivalents run in tests/test_convergence_zero.py on the 8-device CPU
+equivalents run in tests/test_model_convergence.py on the 8-device CPU
 mesh.
 
 Usage: python scripts/convergence_125m.py [--steps 300] [--configs a,b]

@@ -1,0 +1,146 @@
+"""ZeRO-Infinity streaming scale demo: train a multi-billion-param GPT-NeoX
+on ONE chip, with fp32 Adam state in host RAM/NVMe and a quantized offload
+wire (runtime/offload/streaming.py).
+
+This is the repo's analog of the reference's 13B-on-one-32GB-V100
+ZeRO-Offload headline (reference docs/_posts/2020-09-09-ZeRO-Offload.md:10):
+the scale-matched demo for a 16GB v5e is a ~6.7B NeoX. The axon
+host<->device tunnel in this container sustains ~25 MB/s (vs the 12-16 GB/s
+PCIe the reference assumed), so the channel runs int4 with device-side
+stochastic rounding + host-side error feedback; the artifact records the
+measured link rate and the compute/swap-wait breakdown so the numbers are
+interpretable.
+
+Usage:
+  python scripts/infinity_stream.py --model 6.7b --steps 12 --out INFINITY_RUN.json
+  python scripts/infinity_stream.py --model 1.3b --steps 3   # smoke
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="6.7b",
+                    choices=["125m", "1.3b", "6.7b"])
+    ap.add_argument("--steps", type=int, default=12)
+    ap.add_argument("--micro-batch", type=int, default=1)
+    ap.add_argument("--seq", type=int, default=2048)
+    ap.add_argument("--group-layers", type=int, default=1)
+    ap.add_argument("--wire-bits", type=int, default=4)
+    ap.add_argument("--state", default="cpu", choices=["cpu", "nvme"])
+    # Adam's first steps are near-sign-steps (|update| = lr/param while v-hat
+    # adapts): at billion-param scale the global jump lr*sqrt(N) transiently
+    # SPIKES the loss at any headline lr (reproduced with the regular
+    # on-device engine too — this is optimizer dynamics, not a streaming
+    # artifact; production configs hide it inside 3000-step warmups). A
+    # short demo that must descend monotonically wants a small peak lr with
+    # warmup spanning the whole run.
+    ap.add_argument("--lr", type=float, default=8e-6)
+    ap.add_argument("--warmup", type=int, default=14)
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from deeperspeed_tpu.models.gpt import get_preset
+    from deeperspeed_tpu.runtime.offload.streaming import (
+        StreamConfig, StreamedOffloadEngine)
+
+    preset = {"125m": "neox-125m", "1.3b": "neox-1.3b",
+              "6.7b": "neox-6.7b"}[args.model]
+    # tied embeddings: the lm_head's 412MB has no business in a 15GB budget
+    cfg = get_preset(preset, tie_embeddings=True, remat=True,
+                     dtype=jnp.bfloat16, attn_impl="auto", ce_chunk=128,
+                     max_seq=max(args.seq, 2048))
+    scfg = StreamConfig(
+        micro_batch=args.micro_batch, seq=args.seq,
+        group_layers=args.group_layers, wire_bits=args.wire_bits,
+        state_device=args.state, lr=args.lr, warmup_steps=args.warmup,
+    )
+
+    print(f"[infinity_stream] building {preset} engine "
+          f"(wire=int{args.wire_bits}, state={args.state})", flush=True)
+    t0 = time.perf_counter()
+    eng = StreamedOffloadEngine(cfg, scfg)
+    t_build = time.perf_counter() - t0
+    print(f"[infinity_stream] {eng.n_params:,} params; init+upload "
+          f"{t_build:.1f}s (upload {eng.timings['initial_upload_s']:.1f}s)",
+          flush=True)
+
+    # Zipf-distributed tokens: unigram structure the model can visibly
+    # learn inside a handful of steps (uniform tokens have nothing to fit)
+    r = np.random.default_rng(0)
+    V = cfg.vocab_size
+    probs = 1.0 / np.arange(1, V + 1, dtype=np.float64) ** 1.1
+    probs /= probs.sum()
+    B, S = args.micro_batch, args.seq
+
+    losses, step_times, breakdowns = [], [], []
+    prev = {k: v for k, v in eng.timings.items()}
+    for step in range(1, args.steps + 1):
+        tokens = r.choice(V, size=(B, S + 1), p=probs).astype(np.int32)
+        t0 = time.perf_counter()
+        loss = eng.train_batch(tokens)
+        dt = time.perf_counter() - t0
+        cur = dict(eng.timings)
+        delta = {k: round(cur.get(k, 0.0) - prev.get(k, 0.0), 2)
+                 for k in ("compute_s", "d2h_s", "h2d_s", "host_opt_s")}
+        prev = cur
+        losses.append(round(loss, 4))
+        step_times.append(round(dt, 2))
+        breakdowns.append(delta)
+        print(f"[infinity_stream] step {step}/{args.steps} loss={loss:.4f} "
+              f"{dt:.1f}s {delta}", flush=True)
+
+    wire = eng.wire_bytes_per_step()
+    steady = step_times[1:] or step_times
+    steady_bd = breakdowns[1:] or breakdowns
+    mean_step = float(np.mean(steady))
+    xfer = float(np.mean([b["d2h_s"] + b["h2d_s"] for b in steady_bd]))
+    result = {
+        "model": preset,
+        "n_params": eng.n_params,
+        "micro_batch": B, "seq": S,
+        "wire_bits": args.wire_bits,
+        "state_device": args.state,
+        "steps": args.steps,
+        "losses": losses,
+        "loss_first": losses[0], "loss_last": losses[-1],
+        "step_time_s": step_times,
+        "mean_step_s_steady": round(mean_step, 2),
+        "tokens_per_sec": round(B * S / mean_step, 2),
+        "breakdown_steady_mean": {
+            k: round(float(np.mean([b[k] for b in steady_bd])), 2)
+            for k in steady_bd[0]},
+        "wire_bytes_per_step": wire,
+        "effective_link_MBps": round(wire / max(xfer, 1e-9) / 1e6, 2),
+        "initial_upload_s": round(eng.timings["initial_upload_s"], 1),
+        "platform": jax.devices()[0].platform,
+        "device": str(jax.devices()[0].device_kind),
+        "note": (
+            "single-chip ZeRO-Infinity streaming: bf16 params resident on "
+            "the chip, fp32 Adam state (12 bytes/param) in host "
+            f"{args.state}, int{args.wire_bits} offload wire with "
+            "device-side stochastic rounding and host-side error feedback. "
+            "The host link in this container sustains ~25 MB/s (vs PCIe's "
+            "12-16 GB/s assumed by the reference), which is what the "
+            "swap-wait share of the step time reflects."),
+    }
+    print(json.dumps(result))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()
