@@ -52,13 +52,23 @@ def _cached_block(cfg: GPTConfig, x, layer_params, k_cache, v_cache,
     Dh = cfg.head_dim
     B_, S = x.shape[0], x.shape[1]
 
+    vec = jnp.ndim(offset) == 1  # per-row offsets (batched speculative)
+
     def attend(q, k, v):
-        k_c = jax.lax.dynamic_update_slice(
-            k_cache, k.astype(cdt), (0, offset, 0, 0)
-        )
-        v_c = jax.lax.dynamic_update_slice(
-            v_cache, v.astype(cdt), (0, offset, 0, 0)
-        )
+        if vec:
+            # per-row write positions: scatter each row's S new entries at
+            # its own offset
+            rows = jnp.arange(B_, dtype=jnp.int32)[:, None]
+            cols = offset[:, None] + jnp.arange(S, dtype=jnp.int32)[None]
+            k_c = k_cache.at[rows, cols].set(k.astype(cdt))
+            v_c = v_cache.at[rows, cols].set(v.astype(cdt))
+        else:
+            k_c = jax.lax.dynamic_update_slice(
+                k_cache, k.astype(cdt), (0, offset, 0, 0)
+            )
+            v_c = jax.lax.dynamic_update_slice(
+                v_cache, v.astype(cdt), (0, offset, 0, 0)
+            )
         # grouped attention: q heads fold to (Hkv, rep) so the cached K/V
         # are read at their small Hkv width — no materialized repeat (the
         # HBM reads of K/V dominate decode cost)
@@ -69,8 +79,10 @@ def _cached_block(cfg: GPTConfig, x, layer_params, k_cache, v_cache,
                             preferred_element_type=jnp.float32)
         scores = scores / math.sqrt(Dh)
         key_pos = jnp.arange(k_c.shape[1])
-        valid = key_pos[None, :] <= (offset + jnp.arange(S))[:, None]
-        scores = jnp.where(valid[None, None, None], scores, -1e30)
+        q_pos = (offset[:, None] if vec else offset) + jnp.arange(S)
+        valid = key_pos[None, None, :] <= jnp.reshape(
+            q_pos, (-1, S))[:, :, None]  # (B|1, S, max_len)
+        scores = jnp.where(valid[:, None, None], scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1).astype(cdt)
         ctx = jnp.einsum("bhrqk,bkhd->bqhrd", probs, v_c)
         ctx = ctx.reshape(B_, S, Hq, Dh)
@@ -94,7 +106,9 @@ def _cached_block(cfg: GPTConfig, x, layer_params, k_cache, v_cache,
 
 def apply_with_cache(cfg: GPTConfig, params, tokens, cache, offset):
     """Process S tokens given `offset` already-cached ones. Returns
-    (logits (B, S, V), updated cache)."""
+    (logits (B, S, V), updated cache). ``offset`` is a scalar, or an (B,)
+    int vector of PER-ROW offsets (batched speculative decoding, where
+    rows accept different draft lengths and their caches desynchronize)."""
     cdt = cfg.dtype
     B, S = tokens.shape
     if (not cfg.rotary and isinstance(offset, int)
@@ -106,11 +120,13 @@ def apply_with_cache(cfg: GPTConfig, params, tokens, cache, offset):
         )
     wte = params["embed"]["wte"].astype(cdt)
     x = jnp.take(wte, tokens, axis=0)
-    positions = offset + jnp.arange(S, dtype=jnp.int32)
+    if jnp.ndim(offset) == 1:
+        positions = offset[:, None] + jnp.arange(S, dtype=jnp.int32)[None]
+    else:
+        positions = offset + jnp.arange(S, dtype=jnp.int32)
     if not cfg.rotary:
-        x = x + jax.lax.dynamic_slice_in_dim(
-            params["embed"]["wpe"], offset, S, axis=0
-        ).astype(cdt)
+        x = x + jnp.take(params["embed"]["wpe"], positions, axis=0
+                         ).astype(cdt).reshape((-1, S, cfg.d_model))
 
     def scan_body(carry, xs):
         x = carry

@@ -81,6 +81,9 @@ class GPTConfig:
     moe_z_coef: float = 1e-3
     moe_dispatch_impl: str = "auto"  # auto | dense | sorted | dropless
     moe_normalize_gates: bool = False
+    # EP-dropless receive-buffer headroom (see MoEConfig.ep_buffer_factor);
+    # >= the 'expert' axis size guarantees zero drops under any skew
+    moe_ep_buffer_factor: float = 2.0
 
     @property
     def moe(self):
@@ -96,6 +99,7 @@ class GPTConfig:
             z_loss_coef=self.moe_z_coef,
             dispatch_impl=self.moe_dispatch_impl,
             normalize_gates=self.moe_normalize_gates,
+            ep_buffer_factor=self.moe_ep_buffer_factor,
         )
 
     def __post_init__(self):
@@ -267,16 +271,20 @@ def layer_norm(x, scale, bias, eps):
 def rotary_embedding(x, positions, rotary_dims):
     """Apply rotary position embedding to the first rotary_dims of head_dim.
 
-    x: (B, S, H, Dh); positions: (S,)"""
+    x: (B, S, H, Dh); positions: (S,) shared across the batch, or (B, S)
+    per-row absolute positions (batched cache decode, where rows sit at
+    different offsets)."""
     dh = x.shape[-1]
     rot, rest = x[..., :rotary_dims], x[..., rotary_dims:]
     half = rotary_dims // 2
     freq = jnp.exp(
         -math.log(10000.0) * jnp.arange(0, half, dtype=jnp.float32) / half
     )
-    angles = positions[:, None].astype(jnp.float32) * freq[None, :]  # (S, half)
-    cos = jnp.cos(angles)[None, :, None, :].astype(x.dtype)
-    sin = jnp.sin(angles)[None, :, None, :].astype(x.dtype)
+    angles = positions[..., None].astype(jnp.float32) * freq  # (..., S, half)
+    if positions.ndim == 1:
+        angles = angles[None]
+    cos = jnp.cos(angles)[:, :, None, :].astype(x.dtype)
+    sin = jnp.sin(angles)[:, :, None, :].astype(x.dtype)
     x1, x2 = rot[..., :half], rot[..., half:]
     rotated = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
     if rest.shape[-1]:

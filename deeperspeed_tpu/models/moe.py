@@ -51,9 +51,23 @@ class MoEConfig:
     #   buffers (same drop order), so they are loss-equivalent.
     #   "dropless": MegaBlocks-style — sorted assignments feed
     #   jax.lax.ragged_dot grouped matmuls with NO capacity and NO token
-    #   drops (dropped_frac is identically 0). Single-shard experts only
-    #   (does not compose with the 'expert' mesh axis yet).
+    #   drops (dropped_frac is identically 0). With a live 'expert' mesh
+    #   axis the dispatch becomes an explicit shard_map: lax.all_to_all
+    #   with fixed per-destination slots routes each shard's assignments
+    #   to the shard owning the expert (see _moe_ffn_dropless_ep for the
+    #   slot/truncation contract), a local ragged_dot runs the shard's
+    #   experts, and the reverse all_to_all brings outputs home.
     dispatch_impl: str = "auto"  # "auto" | "dense" | "sorted" | "dropless"
+
+    # EP-dropless receive-buffer headroom: each expert shard statically
+    # reserves ep_buffer_factor * (k * T / world) rows (1.0 = perfectly
+    # balanced load). Under skew beyond the factor, overflow assignments
+    # are dropped DETERMINISTICALLY (every shard computes the same greedy
+    # truncation from the all-gathered counts) and reported in
+    # dropped_frac. Set >= the 'expert' axis size for a mathematical
+    # zero-drop guarantee (worst case: every token routes to one shard) at
+    # the cost of proportional buffer memory and ragged_dot padding FLOPs.
+    ep_buffer_factor: float = 2.0
 
     # Combine weights default to RAW softmax probabilities (Switch-style:
     # the mass of unselected experts damps the MoE branch, the residual
@@ -244,6 +258,148 @@ def _moe_ffn_dropless(params, x, cfg: MoEConfig, act, logits, mesh):
     return y, aux
 
 
+def _moe_ffn_dropless_ep(params, x, cfg: MoEConfig, act, mesh):
+    """Dropless dispatch composed with EXPERT PARALLELISM.
+
+    shard_map over the token axes ('data' x 'expert'): every device owns
+    T/world tokens and E/ep experts. Each shard sorts its (token, choice)
+    assignments by global expert id, packs them into fixed per-destination
+    slots, exchanges with ``lax.all_to_all`` (the explicit-SPMD analog of
+    DeepSpeed-MoE's torch all_to_all; portable to XLA:CPU where
+    ragged-all-to-all is not implemented), runs its local experts with ONE
+    ragged_dot (a zero-weight padding group absorbs empty slots), and
+    reverses the exchange to combine at home.
+
+    Static-shape contract: each (sender, destination) pair carries
+    ``cap_pp = ceil(ep_buffer_factor * k * T_local / ep)`` slots.
+    Assignments beyond a pair's slots drop DETERMINISTICALLY (reported in
+    dropped_frac); since one sender holds at most k*T_local assignments
+    for any destination, ``ep_buffer_factor >= ep`` is mathematically
+    dropless under arbitrary routing skew."""
+    from ..ops.ring_attention import _SHMAP_CHECK_KWARGS, shard_map
+    from ..parallel.topology import filter_spec
+
+    B, S, D = x.shape
+    E, k = cfg.num_experts, cfg.top_k
+    T = B * S
+    if SEQ_AXIS in mesh.axis_names and mesh.shape[SEQ_AXIS] > 1:
+        raise ValueError(
+            "dropless EP does not compose with sequence parallelism; "
+            "use dispatch_impl='sorted' when the 'seq' axis is live"
+        )
+    token_axes = tuple(
+        a for a in (DATA_AXIS, EXPERT_AXIS)
+        if a in mesh.axis_names and mesh.shape[a] > 1
+    )
+    ep = mesh.shape[EXPERT_AXIS]
+    world = math.prod(mesh.shape[a] for a in token_axes)
+    if E % ep:
+        raise ValueError(f"num_experts {E} not divisible by expert axis {ep}")
+    e_loc = E // ep
+    if T % world:
+        raise ValueError(f"tokens {T} not divisible by mesh world {world}")
+    t_loc = T // world
+    cap_pp = max(1, int(math.ceil(cfg.ep_buffer_factor * k * t_loc / ep)))
+    cap = ep * cap_pp
+
+    def body(xt, wg, wi, bi, wo, bo):
+        # xt (t_loc, D); wi/bi/wo/bo carry this shard's e_loc experts
+        xt = xt.reshape(t_loc, D)
+        my = jax.lax.axis_index(EXPERT_AXIS)
+        logits = xt.astype(jnp.float32) @ wg.astype(jnp.float32)
+        probs, expert_idx, gate = router_topk(logits, k, cfg.normalize_gates)
+        # choice-major flatten + stable sort by global expert id: rows for
+        # each destination shard are contiguous runs
+        e_flat = expert_idx.T.reshape(-1)
+        tid = jnp.tile(jnp.arange(t_loc, dtype=jnp.int32), k)
+        order = jnp.argsort(e_flat, stable=True)
+        e_s = e_flat[order]
+        tid_s = tid[order]
+        gate_s = gate.T.reshape(-1)[order]
+        dest = e_s // e_loc  # (k*t_loc,) destination shard per assignment
+        shard_starts = jnp.searchsorted(
+            e_s, jnp.arange(ep, dtype=jnp.int32) * e_loc).astype(jnp.int32)
+        pos = (jnp.arange(k * t_loc, dtype=jnp.int32)
+               - shard_starts[dest])  # rank within my run for that dest
+        ok = pos < cap_pp  # pair-level slots; beyond = deterministic drop
+        dropped = jnp.sum(1.0 - ok.astype(jnp.float32))
+        slot = jnp.where(ok, dest * cap_pp + pos, cap)  # cap = dump row
+
+        xs = xt[tid_s]  # (k*t_loc, D)
+        sendx = jnp.zeros((cap + 1, D), xs.dtype).at[slot].set(xs)[:cap]
+        sende = jnp.full((cap + 1,), E, jnp.int32).at[slot].set(e_s)[:cap]
+        # (ep, cap_pp, ...) blocks; device d receives every sender's d-th
+        # block — DeepSpeed-MoE's all_to_all with explicit slot packing
+        x_recv = jax.lax.all_to_all(
+            sendx.reshape(ep, cap_pp, D), EXPERT_AXIS, 0, 0).reshape(cap, D)
+        e_recv = jax.lax.all_to_all(
+            sende.reshape(ep, cap_pp), EXPERT_AXIS, 0, 0).reshape(cap)
+
+        # group received rows by local expert; sentinel padding sorts last
+        e_local = jnp.where(e_recv >= E, e_loc, e_recv - my * e_loc)
+        order2 = jnp.argsort(e_local, stable=True)
+        xs2 = x_recv[order2]
+        e2 = e_local[order2]
+        group_sizes = jnp.zeros((e_loc + 1,), jnp.int32).at[e2].add(1)
+
+        zpadW = lambda w: jnp.concatenate(
+            [w, jnp.zeros((1,) + w.shape[1:], w.dtype)])
+        h = jax.lax.ragged_dot(
+            xs2, zpadW(wi.astype(xs2.dtype)), group_sizes).astype(xs2.dtype)
+        h = h + zpadW(bi.astype(xs2.dtype))[e2]
+        h = act(h)
+        eo = jax.lax.ragged_dot(
+            h, zpadW(wo.astype(xs2.dtype)), group_sizes).astype(xs2.dtype)
+        eo = eo + zpadW(bo.astype(xs2.dtype))[e2]
+        eo = jnp.zeros_like(eo).at[order2].set(eo)  # back to recv order
+
+        # reverse exchange brings each slot home to its sender
+        eo_home = jax.lax.all_to_all(
+            eo.reshape(ep, cap_pp, D), EXPERT_AXIS, 0, 0).reshape(cap, D)
+
+        # fp32 combine at home; dropped assignments contribute zero
+        okf = ok.astype(jnp.float32)
+        eo_s = eo_home[jnp.clip(slot, 0, cap - 1)]
+        contrib = (eo_s.astype(jnp.float32)
+                   * (gate_s.astype(jnp.float32) * okf)[:, None])
+        yt = jnp.zeros((t_loc, D), jnp.float32).at[tid_s].add(contrib)
+
+        pmean = lambda v: jax.lax.pmean(
+            v, token_axes if len(token_axes) > 1 else token_axes[0])
+        aux_local = {
+            "mean_prob": jnp.mean(probs, axis=0),
+            "top1_frac": jnp.zeros(E, jnp.float32)
+                           .at[expert_idx[:, 0]].add(1.0) / t_loc,
+            "dropped_frac": dropped / (k * t_loc),
+            "z": router_z_loss(logits),
+        }
+        return yt.astype(x.dtype), jax.tree.map(pmean, aux_local)
+
+    tok_spec = P(token_axes if len(token_axes) > 1 else
+                 (token_axes[0] if token_axes else None), None)
+    exp = lambda *rest: filter_spec(P(EXPERT_AXIS, *rest), mesh)
+    yt, aux_s = shard_map(
+        body, mesh=mesh,
+        in_specs=(tok_spec, P(None, None), exp(None, None), exp(None),
+                  exp(None, None), exp(None)),
+        out_specs=(tok_spec, P()),
+        **_SHMAP_CHECK_KWARGS,
+    )(x.reshape(T, D),
+      params["router"]["wg"],
+      params["experts"]["wi"], params["experts"]["bi"],
+      params["experts"]["wo"], params["experts"]["bo"])
+
+    y = yt.reshape(B, S, D)
+    y = _constrain(y, mesh, P(DATA_AXIS, SEQ_AXIS, None))
+    aux = {
+        "aux_loss": load_balancing_loss(
+            aux_s["mean_prob"], aux_s["top1_frac"], E),
+        "z_loss": aux_s["z"],
+        "dropped_frac": aux_s["dropped_frac"],
+    }
+    return y, aux
+
+
 def moe_ffn(params, x, cfg: MoEConfig, mesh=None, activation=None):
     """Drop-in MoE replacement for a dense FFN block.
 
@@ -256,22 +412,21 @@ def moe_ffn(params, x, cfg: MoEConfig, mesh=None, activation=None):
     T = B * S
     act = activation or (lambda h: jax.nn.gelu(h, approximate=True))
 
+    impl = cfg.resolved_dispatch_impl()
+    if impl == "dropless" and (
+            mesh is not None and EXPERT_AXIS in mesh.axis_names
+            and mesh.shape[EXPERT_AXIS] > 1):
+        # EP path computes its router on per-shard tokens inside shard_map
+        return _moe_ffn_dropless_ep(params, x, cfg, act, mesh)
+
     xt = x.reshape(T, D)
     logits = (xt.astype(jnp.float32)
               @ params["router"]["wg"].astype(jnp.float32))  # (T, E)
     # k*T assignments spread over E buffers (GShard convention: capacity
     # scales with top_k, else top-2 structurally drops second choices)
     capacity = max(1, math.ceil(k * T / E * cfg.capacity_factor))
-    impl = cfg.resolved_dispatch_impl()
 
     if impl == "dropless":
-        if (mesh is not None and EXPERT_AXIS in mesh.axis_names
-                and mesh.shape[EXPERT_AXIS] > 1):
-            raise ValueError(
-                "dispatch_impl='dropless' does not compose with expert "
-                "parallelism yet (ragged groups cannot ride the 'expert' "
-                "mesh axis); use 'sorted' or 'dense'"
-            )
         return _moe_ffn_dropless(params, x, cfg, act, logits, mesh)
 
     if impl == "sorted":

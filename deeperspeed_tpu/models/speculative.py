@@ -25,12 +25,26 @@ output buffer, offsets advance by the accepted length). Stale KV-cache
 entries beyond the rolled-back offset need no cleanup: the attention mask
 is offset-derived, so they are invisible until overwritten.
 
+BATCHED decoding (B > 1): rows accept different draft lengths, so their
+caches desynchronize — per-row offsets flow through ``apply_with_cache``
+(vector-offset cache writes + per-row positional masks/rotary), per-row
+output cursors advance independently, and finished rows keep looping as
+masked no-ops until the slowest row reaches ``max_new_tokens``. Each
+row's greedy output is bit-identical to its own B=1 decode (fp32).
+
 ``temperature > 0`` runs standard speculative SAMPLING (Leviathan et
 al.): accept draft token d with probability min(1, p_t(d)/p_d(d)); on
 rejection, sample the replacement from norm(max(p_t - p_d, 0)) with a
 key independent of the rejected draw. Sampling keys fold per OUTPUT
-POSITION, so a perfect draft reproduces plain ancestral sampling of the
-target exactly.
+POSITION (per row when B > 1), so a perfect draft reproduces plain
+ancestral sampling of the target exactly. An explicit ``rng`` is
+REQUIRED when sampling — a silent default key would return identical
+"samples" on every call.
+
+Compilation note: ``max_new_tokens``, ``temperature`` and ``top_k`` are
+static jit arguments — every distinct sampling configuration compiles its
+own program (the two-model loop re-specializes). Reuse configurations
+rather than sweeping them per call.
 
 Usage::
 
@@ -38,9 +52,6 @@ Usage::
     out = gen(target_params, draft_params, prompt, max_new_tokens=64)
     out = gen(target_params, draft_params, prompt, max_new_tokens=64,
               temperature=0.9, top_k=40, rng=key)
-
-Batch size 1 (the speculative serving case; per-row accept counts would
-need per-row cache offsets).
 """
 
 from functools import partial
@@ -65,12 +76,24 @@ def _pos_key(rng, pos):
     return jax.random.fold_in(rng, pos)
 
 
+def _row_streams(rng, B: int):
+    """(B,) key array: row r's stream. B == 1 keeps the stream EXACTLY as
+    the unbatched convention (no row fold), preserving the documented
+    draft==target == ancestral-sampling bit-parity; B > 1 folds the row
+    index for independent per-row streams."""
+    if B == 1:
+        return rng[None]
+    return jax.vmap(lambda r: jax.random.fold_in(rng, r))(
+        jnp.arange(B, dtype=jnp.uint32))
+
+
 def make_speculative_generator(target_cfg: GPTConfig, draft_cfg: GPTConfig,
                                k_draft: int = 4):
     """Build a jitted speculative generate(target_params, draft_params,
     prompt, max_new_tokens, temperature=0.0, top_k=None, rng=None)
     -> (B, S+max_new_tokens) tokens. temperature<=0 = greedy (bit-parity
-    with plain greedy target decoding); >0 = rejection sampling."""
+    with plain greedy target decoding, per row); >0 = rejection sampling
+    (explicit rng required)."""
     assert target_cfg.vocab_size == draft_cfg.vocab_size, (
         "target and draft must share a vocabulary")
     K = int(k_draft)
@@ -82,10 +105,6 @@ def make_speculative_generator(target_cfg: GPTConfig, draft_cfg: GPTConfig,
                  temperature: float = 0.0, top_k: Optional[int] = None,
                  rng=None):
         B, S = prompt.shape
-        if B != 1:
-            raise ValueError(
-                "speculative decoding supports batch size 1 (per-row accept "
-                f"counts would need per-row cache offsets); got B={B}")
         if max_new_tokens < 1:
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {max_new_tokens}")
@@ -97,6 +116,10 @@ def make_speculative_generator(target_cfg: GPTConfig, draft_cfg: GPTConfig,
                     f"prompt ({S}) + max_new_tokens ({max_new_tokens}) + "
                     f"draft slack ({K + 1}) exceeds max_seq ({cfg.max_seq})")
         sampling = temperature > 0.0
+        if sampling and rng is None:
+            raise ValueError(
+                "temperature > 0 requires an explicit rng: a default key "
+                "would return the same 'samples' on every call")
         if rng is None:
             rng = jax.random.PRNGKey(0)
         # three independent streams: proposal/bonus draws, acceptance
@@ -105,6 +128,18 @@ def make_speculative_generator(target_cfg: GPTConfig, draft_cfg: GPTConfig,
         # the same Gumbel vector, conditioning the replacement on the
         # rejected token and skewing it away from norm(max(p_t - p_d, 0)).
         rng_tok, rng_acc, rng_fix = jax.random.split(rng, 3)
+        tok_s = _row_streams(rng_tok, B)
+        acc_s = _row_streams(rng_acc, B)
+        fix_s = _row_streams(rng_fix, B)
+        rows_i = jnp.arange(B, dtype=jnp.int32)
+
+        def draw(streams, pos, logits):
+            """Per-row categorical with per-(row, position) keys.
+            pos (B,); logits (B, V)."""
+            return jax.vmap(
+                lambda st, p, l: jax.random.categorical(
+                    _pos_key(st, p), l, axis=-1)
+            )(streams, pos, logits).astype(jnp.int32)
 
         t_cache = init_cache(target_cfg, B, max_len)
         d_cache = init_cache(draft_cfg, B, max_len)
@@ -113,55 +148,54 @@ def make_speculative_generator(target_cfg: GPTConfig, draft_cfg: GPTConfig,
         _, d_cache = apply_with_cache(
             draft_cfg, draft_params, prompt, d_cache, 0)
         if sampling:
-            first = jax.random.categorical(
-                _pos_key(rng_tok, 0),
-                _prep_logits(t_logits[:, -1], temperature, top_k),
-                axis=-1).astype(jnp.int32)
+            first = draw(tok_s, jnp.zeros((B,), jnp.int32),
+                         _prep_logits(t_logits[:, -1], temperature, top_k))
         else:
             first = jnp.argmax(t_logits[:, -1], axis=-1).astype(jnp.int32)
 
-        out = jnp.zeros((B, max_new_tokens + K + 1), jnp.int32)
-        out = jax.lax.dynamic_update_slice(out, first[:, None], (0, 0))
+        W = max_new_tokens + K + 1
+        out = jnp.zeros((B, W), jnp.int32)
+        out = out.at[:, 0].set(first)
 
-        # invariant at loop top: `n` tokens emitted (out[:, :n]); `last` is
-        # the newest emitted token, NOT yet in either cache; both caches
-        # hold exactly the S + n - 1 tokens before it.
+        # invariant at loop top, PER ROW r: n[r] tokens emitted
+        # (out[r, :n[r]]); last[r] is the newest emitted token, not yet in
+        # either cache; both caches hold the S + n[r] - 1 tokens before it.
         def cond(carry):
             n = carry[1]
-            return n < max_new_tokens
+            return jnp.any(n < max_new_tokens)
 
         def body(carry):
             out, n, last, t_cache, d_cache = carry
-            offset = S + n - 1  # tokens in both caches
+            offsets = S + n - 1  # (B,) tokens in both caches, per row
 
             # --- draft phase: propose K tokens (and cache d_K too, so the
             # draft cache stays ahead even on full acceptance) ---
             def draft_step(carry, j):
                 tok, cache = carry
                 logits, cache = apply_with_cache(
-                    draft_cfg, draft_params, tok[:, None], cache, offset + j)
-                row = logits[:, -1]
+                    draft_cfg, draft_params, tok[:, None], cache,
+                    offsets + j)
+                row = logits[:, -1]  # (B, V)
                 if sampling:
                     # the PER-OUTPUT-POSITION key: a token proposed for
                     # output index n+j draws with the same key ancestral
                     # sampling would use there, so draft == target
                     # reproduces plain sampling exactly
-                    nxt = jax.random.categorical(
-                        _pos_key(rng_tok, n + j),
-                        _prep_logits(row, temperature, top_k),
-                        axis=-1).astype(jnp.int32)
+                    nxt = draw(tok_s, n + j,
+                               _prep_logits(row, temperature, top_k))
                 else:
                     nxt = jnp.argmax(row, axis=-1).astype(jnp.int32)
-                return (nxt, cache), (nxt, row[0])
+                return (nxt, cache), (nxt, row)
 
             (_, d_cache), (drafts_all, d_rows) = jax.lax.scan(
                 draft_step, (last, d_cache), jnp.arange(K + 1))
-            drafts = drafts_all[:K, 0]  # (K,) proposed tokens d_1..d_K
+            drafts = drafts_all[:K].T  # (B, K) proposed tokens d_1..d_K
+            d_rows = jnp.swapaxes(d_rows, 0, 1)  # (B, K+1, V)
 
-            # --- verify phase: one target forward over [last, d_1..d_K] ---
-            block = jnp.concatenate([last, drafts], axis=0)[None]  # (1, K+1)
+            # --- verify: one target forward over [last, d_1..d_K] ---
+            block = jnp.concatenate([last[:, None], drafts], axis=1)
             t_logits, t_cache = apply_with_cache(
-                target_cfg, target_params, block, t_cache, offset)
+                target_cfg, target_params, block, t_cache, offsets)
 
             idx = jnp.arange(K + 1, dtype=jnp.int32)
             if sampling:
@@ -171,51 +205,64 @@ def make_speculative_generator(target_cfg: GPTConfig, draft_cfg: GPTConfig,
                 # with a zero row makes the full-acceptance bonus draw
                 # come from p_t[K] through the same expression.
                 p_t = jax.nn.softmax(
-                    _prep_logits(t_logits[0], temperature, top_k), axis=-1)
+                    _prep_logits(t_logits, temperature, top_k), axis=-1)
                 p_d = jax.nn.softmax(
-                    _prep_logits(d_rows[:K], temperature, top_k), axis=-1)
-                ratio = (p_t[jnp.arange(K), drafts]
-                         / (p_d[jnp.arange(K), drafts] + 1e-20))
-                u = jax.vmap(
-                    lambda j: jax.random.uniform(_pos_key(rng_acc, n + j))
-                )(jnp.arange(K))
+                    _prep_logits(d_rows[:, :K], temperature, top_k), axis=-1)
+                kk = jnp.arange(K)
+                ratio = (
+                    jnp.take_along_axis(
+                        p_t[:, :K], drafts[:, :, None], axis=-1)[..., 0]
+                    / (jnp.take_along_axis(
+                        p_d, drafts[:, :, None], axis=-1)[..., 0] + 1e-20))
+                u = jax.vmap(lambda st, nr: jax.vmap(
+                    lambda j: jax.random.uniform(_pos_key(st, nr + j))
+                )(kk))(acc_s, n)  # (B, K)
                 accept = (u <= ratio).astype(jnp.int32)
-                n_acc = jnp.sum(jnp.cumprod(accept))
+                n_acc = jnp.sum(jnp.cumprod(accept, axis=1), axis=1)  # (B,)
                 p_d_pad = jnp.concatenate(
-                    [p_d, jnp.zeros((1,) + p_d.shape[1:], p_d.dtype)], axis=0)
-                resid = jnp.clip(p_t[n_acc] - p_d_pad[n_acc], 0.0)
-                total = jnp.sum(resid)
+                    [p_d, jnp.zeros_like(p_d[:, :1])], axis=1)
+                p_t_at = p_t[rows_i, n_acc]          # (B, V)
+                p_d_at = p_d_pad[rows_i, n_acc]
+                resid = jnp.clip(p_t_at - p_d_at, 0.0)
+                total = jnp.sum(resid, axis=-1, keepdims=True)
                 q = jnp.where(total > 0, resid / jnp.maximum(total, 1e-20),
-                              p_t[n_acc])
+                              p_t_at)
                 # full acceptance (n_acc == K): the bonus comes from p_t[K]
                 # and must use the POSITIONAL token key so a perfect draft
                 # reproduces ancestral sampling. A rejection replacement
                 # needs a key INDEPENDENT of the rejected proposal's draw.
-                bonus_key = jnp.where(
-                    n_acc == K,
-                    _pos_key(rng_tok, n + n_acc),
-                    _pos_key(rng_fix, n + n_acc),
-                )
-                bonus = jax.random.categorical(
-                    bonus_key, jnp.log(q + 1e-20)).astype(jnp.int32)
+                bonus = jax.vmap(
+                    lambda ts, fs, nr, na, qr: jax.random.categorical(
+                        jnp.where(na == K, _pos_key(ts, nr + na),
+                                  _pos_key(fs, nr + na)),
+                        jnp.log(qr + 1e-20))
+                )(tok_s, fix_s, n, n_acc, q).astype(jnp.int32)
             else:
-                t_preds = jnp.argmax(t_logits[0], axis=-1).astype(jnp.int32)
-                # t_preds[j] = target's token after consuming block[:j+1]
-                matches = (drafts == t_preds[:K]).astype(jnp.int32)
-                n_acc = jnp.sum(jnp.cumprod(matches))  # 0..K
-                bonus = t_preds[n_acc]
+                t_preds = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
+                # t_preds[r, j] = target's token after consuming block[:j+1]
+                matches = (drafts == t_preds[:, :K]).astype(jnp.int32)
+                n_acc = jnp.sum(jnp.cumprod(matches, axis=1), axis=1)
+                bonus = t_preds[rows_i, n_acc]
 
-            # emitted this round: accepted drafts then the replacement /
-            # bonus token at the first mismatch (or after full acceptance)
-            emitted = jnp.where(idx < n_acc, jnp.append(drafts, 0), bonus)
-            # positions >= n_acc+1 hold `bonus` copies: they are either
-            # overwritten by the next round's write at n + n_acc + 1 or
-            # fall beyond max_new_tokens and are sliced off.
-            out = jax.lax.dynamic_update_slice(out, emitted[None], (0, n))
-            return (out, n + n_acc + 1, bonus[None], t_cache, d_cache)
+            # emitted this round, per row: accepted drafts then the
+            # replacement / bonus at the first mismatch (or after full
+            # acceptance); finished rows re-write their existing tokens
+            drafts_pad = jnp.concatenate(
+                [drafts, jnp.zeros((B, 1), jnp.int32)], axis=1)
+            emitted = jnp.where(idx[None] < n_acc[:, None], drafts_pad,
+                                bonus[:, None])
+            done = n >= max_new_tokens
+            cols = jnp.clip(n[:, None] + idx[None], 0, W - 1)
+            cur = out[rows_i[:, None], cols]
+            vals = jnp.where(done[:, None], cur, emitted)
+            out = out.at[rows_i[:, None], cols].set(vals)
+            n = jnp.where(done, n, n + n_acc + 1)
+            last = jnp.where(done, last, bonus)
+            return (out, n, last, t_cache, d_cache)
 
+        n0 = jnp.ones((B,), jnp.int32)
         out, _, _, _, _ = jax.lax.while_loop(
-            cond, body, (out, jnp.int32(1), first, t_cache, d_cache))
+            cond, body, (out, n0, first, t_cache, d_cache))
         return jnp.concatenate([prompt, out[:, :max_new_tokens]], axis=1)
 
     return generate

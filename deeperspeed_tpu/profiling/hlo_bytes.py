@@ -1,0 +1,77 @@
+"""Collective bytes-on-wire audit from compiled HLO.
+
+The reference proved its 1-bit optimizer's communication claim with NCCL
+byte counters; the XLA analog is the compiled program itself: every
+collective op's result shape is in the HLO text, so the bytes a program
+moves per step can be read without multi-chip hardware. Used by
+scripts/onebit_wire_bytes.py to compare the fp32-warmup vs compressed-phase
+programs of runtime/comm/onebit_spmd.py.
+"""
+
+import re
+from typing import Dict
+
+_DTYPE_BYTES = {
+    "f64": 8, "f32": 4, "f16": 2, "bf16": 2, "f8e4m3": 1, "f8e5m2": 1,
+    "s64": 8, "u64": 8, "s32": 4, "u32": 4, "s16": 2, "u16": 2,
+    "s8": 1, "u8": 1, "pred": 1,
+}
+
+_COLLECTIVES = ("all-reduce", "all-gather", "all-to-all", "reduce-scatter",
+                "collective-permute")
+
+# one typed buffer, e.g. f32[8,128]{1,0} or u8[64]
+_SHAPE = re.compile(r"(\w+?)\[([\d,]*)\](?:\{[^}]*\})?")
+
+
+def _shape_bytes(type_str: str) -> int:
+    total = 0
+    for dtype, dims in _SHAPE.findall(type_str):
+        if dtype not in _DTYPE_BYTES:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * _DTYPE_BYTES[dtype]
+    return total
+
+
+def collective_wire_bytes(hlo_text: str, world: int = 0) -> Dict[str, int]:
+    """Audit every collective op in an HLO module.
+
+    Returns per-op RESULT bytes plus ``total`` (their sum) and — when
+    ``world`` is given — ``wire_total``: the standard per-device link-cost
+    model (ring all-reduce moves 2(W-1)/W x result; all-gather /
+    reduce-scatter / all-to-all move (W-1)/W x result; collective-permute
+    moves 1x). Comparing two programs by wire_total gives the physical
+    bytes-on-wire reduction factor without multi-chip hardware."""
+    out: Dict[str, float] = {op: 0 for op in _COLLECTIVES}
+    for line in hlo_text.splitlines():
+        line = line.strip()
+        m = re.match(r"%?[\w.\-]+ = (.+?) (" + "|".join(_COLLECTIVES)
+                     + r")(-start|-done)?\(", line)
+        if not m:
+            continue
+        if m.group(3) == "-done":  # started op already counted
+            continue
+        out[m.group(2)] += _shape_bytes(m.group(1))
+    out["total"] = sum(out[op] for op in _COLLECTIVES)
+    if world > 1:
+        f = (world - 1) / world
+        out["wire_total"] = int(
+            out["all-reduce"] * 2 * f
+            + (out["all-gather"] + out["reduce-scatter"]
+               + out["all-to-all"]) * f
+            + out["collective-permute"])
+    return out
+
+
+def compiled_wire_bytes(jitted, *args, world: int = 0,
+                        **kwargs) -> Dict[str, int]:
+    """Lower+compile a jitted callable and audit its collective bytes."""
+    compiled = jitted.lower(*args, **kwargs).compile()
+    text = "\n".join(m.to_string() for m in compiled.runtime_executable()
+                     .hlo_modules()) if hasattr(
+        compiled, "runtime_executable") else compiled.as_text()
+    return collective_wire_bytes(text, world=world)

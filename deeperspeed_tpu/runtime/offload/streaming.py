@@ -283,6 +283,10 @@ class StreamedOffloadEngine:
             raise ValueError("n_layer must be divisible by group_layers")
         if scfg.wire_bits not in (4, 8, 16, 32):
             raise ValueError("wire_bits must be 4, 8, 16 or 32")
+        if scfg.wire_block <= 0 or scfg.wire_block % 2:
+            raise ValueError(
+                f"wire_block must be positive and even (int4 half-split "
+                f"nibble packing), got {scfg.wire_block}")
         if cfg.moe is not None:
             raise NotImplementedError(
                 "StreamedOffloadEngine supports dense GPT models")
@@ -301,14 +305,13 @@ class StreamedOffloadEngine:
             lr=scfg.lr, betas=scfg.betas, eps=scfg.eps,
             weight_decay=scfg.weight_decay)
 
-        # ---------------- host state ---------------- #
-        if host_params is None:
-            host_params = self._host_init()
-        self._leaf_templates, chunks = self._chunk(host_params)
-        self.chunk_names = list(chunks)
-        self.n_params = int(sum(c.size for c in chunks.values()))
-        self._meta = {c: _ChunkMeta(self._leaf_templates[c], scfg.wire_bits)
-                      for c in self.chunk_names}
+        # ---------------- host state (streamed: one chunk at a time — a
+        # 6.7B model's fp32 pytree is 27GB; materializing it NEXT TO the
+        # 80GB Adam state OOMs a 125GB host) ---------------- #
+        self._leaf_templates: Dict[str, Any] = {}
+        self.chunk_names: List[str] = []
+        self.n_params = 0
+        self._meta: Dict[str, _ChunkMeta] = {}
         self._shadow: Dict[str, np.ndarray] = {}   # uint16 bf16 bits
         self._ram: Dict[str, Dict[str, np.ndarray]] = {}
         self.swapper = None
@@ -318,11 +321,16 @@ class StreamedOffloadEngine:
             cls = (PipelinedOptimizerSwapper if scfg.pipeline_swap
                    else PartitionedOptimizerSwapper)
             self.swapper = cls(AioConfig(), folder)
-        for cname, flat in chunks.items():
+        for cname, template, flat in self._iter_chunks(host_params):
+            self._leaf_templates[cname] = template
+            self.chunk_names.append(cname)
+            self.n_params += flat.size
+            self._meta[cname] = _ChunkMeta(template, scfg.wire_bits)
             self._shadow[cname] = f32_to_bf16_bits(flat)
+            del flat
             # master tracks the SHADOW (what the device actually holds),
             # so step 0 starts with zero residual
-            master = bf16_bits_to_f32(self._shadow[cname]).copy()
+            master = bf16_bits_to_f32(self._shadow[cname])
             states = {"master": master,
                       "exp_avg": np.zeros_like(master),
                       "exp_avg_sq": np.zeros_like(master)}
@@ -330,13 +338,12 @@ class StreamedOffloadEngine:
                 self._ram[cname] = states
             else:
                 self.swapper.register_leaf(cname, states)
-                del states
+            del states, master
         log_dist(
             f"StreamedOffloadEngine: {self.n_params:,} params, "
             f"{self.n_groups} groups, wire=int{scfg.wire_bits}, "
             f"Adam state ({self.n_params * 12 / 2**30:.1f} GB fp32) on "
             f"{scfg.state_device}", ranks=[0])
-        del chunks, host_params
 
         # ---------------- device state ---------------- #
         self._dev_groups: List[Any] = []
@@ -348,46 +355,63 @@ class StreamedOffloadEngine:
     # init / chunk layout
     # ------------------------------------------------------------- #
 
-    def _host_init(self) -> dict:
-        """Host-side init mirroring models/gpt.py:init_params without ever
-        materializing fp32 params on device (for 6.7B that is 27GB)."""
+    def _iter_chunks(self, host_params):
+        """Yield (chunk_name, device leaf template, flat fp32) one chunk at
+        a time. Given params are chunked via _chunk; fresh-init generates
+        each group's tensors on demand so at most ONE chunk's fp32 data is
+        transient — never the whole model's."""
+        if host_params is not None:
+            templates, chunks = self._chunk(host_params)
+            for cname in chunks:
+                yield cname, templates[cname], chunks[cname]
+            return
         cfg = self.cfg
-        D, F, L, V = cfg.d_model, cfg.ffn_dim, cfg.n_layer, cfg.vocab_size
-        std, out_std = 0.02, 0.02 / np.sqrt(2.0 * L)
+        D, F = cfg.d_model, cfg.ffn_dim
+        G, V = self.scfg.group_layers, cfg.vocab_size
+        std, out_std = 0.02, 0.02 / np.sqrt(2.0 * cfg.n_layer)
         r = self._rng
 
         def norm(shape, s):
             return (r.standard_normal(shape, np.float32) * s).astype(
                 np.float32)
 
-        params = {
-            "embed": {"wte": norm((V, D), std)},
-            "layers": {
-                "ln1_scale": np.ones((L, D), np.float32),
-                "ln1_bias": np.zeros((L, D), np.float32),
-                "ln2_scale": np.ones((L, D), np.float32),
-                "ln2_bias": np.zeros((L, D), np.float32),
+        def emit(tree):
+            template = jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, jnp.bfloat16), tree)
+            flat = np.concatenate(
+                [l.reshape(-1) for l in jax.tree.leaves(tree)])
+            return template, flat
+
+        for g in range(self.n_groups):
+            # same structure (hence tree.leaves order) as models/gpt.py
+            # init_params' per-layer stack, sliced to this group
+            lay = {
+                "ln1_scale": np.ones((G, D), np.float32),
+                "ln1_bias": np.zeros((G, D), np.float32),
+                "ln2_scale": np.ones((G, D), np.float32),
+                "ln2_bias": np.zeros((G, D), np.float32),
                 "attn": {
-                    "wqkv": norm((L, D, cfg.qkv_dim), std),
-                    "bqkv": np.zeros((L, cfg.qkv_dim), np.float32),
-                    "wo": norm((L, D, D), out_std),
-                    "bo": np.zeros((L, D), np.float32),
+                    "wqkv": norm((G, D, cfg.qkv_dim), std),
+                    "bqkv": np.zeros((G, cfg.qkv_dim), np.float32),
+                    "wo": norm((G, D, D), out_std),
+                    "bo": np.zeros((G, D), np.float32),
                 },
                 "mlp": {
-                    "wi": norm((L, D, F), std),
-                    "bi": np.zeros((L, F), np.float32),
-                    "wo": norm((L, F, D), out_std),
-                    "bo": np.zeros((L, D), np.float32),
+                    "wi": norm((G, D, F), std),
+                    "bi": np.zeros((G, F), np.float32),
+                    "wo": norm((G, F, D), out_std),
+                    "bo": np.zeros((G, D), np.float32),
                 },
-            },
-            "final_ln": {"scale": np.ones((D,), np.float32),
-                         "bias": np.zeros((D,), np.float32)},
-        }
+            }
+            yield (f"g{g}",) + emit(lay)
+        gl = {"embed": {"wte": norm((V, D), std)},
+              "final_ln": {"scale": np.ones((D,), np.float32),
+                           "bias": np.zeros((D,), np.float32)}}
         if not cfg.rotary:
-            params["embed"]["wpe"] = norm((cfg.max_seq, D), std)
+            gl["embed"]["wpe"] = norm((cfg.max_seq, D), std)
         if not cfg.tie_embeddings:
-            params["lm_head"] = norm((D, V), std)
-        return params
+            gl["lm_head"] = norm((D, V), std)
+        yield ("globals",) + emit(gl)
 
     def _chunk(self, params: dict):
         """Split the param pytree into per-group flat fp32 chunks plus one

@@ -1,0 +1,85 @@
+"""Bytes-on-wire evidence for 1-bit Adam (reference claim: ~5x end-to-end
+comm reduction from 1-bit momentum exchange, deepspeed 0.3.15 onebit blog).
+
+Compiles the SAME data-parallel train step (tiny GPT on a dp8 mesh) in the
+warmup phase (fp32 gradient pmean) and the compressed phase (1-bit
+two-phase momentum exchange, runtime/comm/onebit_spmd.py), audits every
+collective's result bytes in the compiled HLO, and writes
+ONEBIT_WIRE.json with the measured reduction factor. Runs on the virtual
+CPU mesh — the compiled program, not hardware, is the evidence.
+
+Usage: run under the cleaned 8-device env (see tests/conftest.py), or let
+it re-exec itself.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REEXEC_FLAG = "DS_ONEBIT_WIRE_REEXEC"
+
+
+def main():
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import jax
+
+    if len(jax.devices()) < 8 and not os.environ.get(REEXEC_FLAG):
+        env = dict(os.environ)
+        env[REEXEC_FLAG] = "1"
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                            + " --xla_force_host_platform_device_count=8"
+                            ).strip()
+        env.pop("PYTHONPATH", None)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        sys.exit(subprocess.call([sys.executable, os.path.abspath(__file__)],
+                                 env=env))
+
+    import numpy as np
+
+    from deeperspeed_tpu.models.gpt import GPTConfig, make_gpt
+    from deeperspeed_tpu.parallel import build_mesh
+    from deeperspeed_tpu.profiling.hlo_bytes import compiled_wire_bytes
+    from deeperspeed_tpu.runtime.comm.onebit import OnebitAdam
+    from deeperspeed_tpu.runtime.comm.onebit_spmd import (
+        make_onebit_spmd_train_step)
+
+    mesh = build_mesh({"data": 8})
+    cfg = GPTConfig(vocab_size=512, n_layer=2, n_head=4, d_model=128,
+                    max_seq=64, attn_impl="xla", remat=True)
+    init_fn, _, loss_fn, _ = make_gpt(cfg)
+    params = init_fn(jax.random.PRNGKey(0))
+    n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+    opt = OnebitAdam(lr=1e-3, freeze_step=2)
+    batch = np.zeros((16, 33), np.int32)
+
+    result = {"n_params": n_params, "mesh": "dp8"}
+    for phase in ("warmup", "compressed"):
+        init_comm, step = make_onebit_spmd_train_step(
+            loss_fn, opt, mesh, phase=phase)
+        comm = init_comm(params)
+        bytes_by_op = compiled_wire_bytes(step, params, comm, batch, 1e-3,
+                                          3, world=8)
+        result[phase] = bytes_by_op
+        # correctness: the compiled program must actually run
+        p2, comm, loss = step(params, comm, batch, 1e-3, 3)
+        result[phase]["loss_ok"] = bool(np.isfinite(float(loss)))
+
+    # wire_total models per-device link cost (ring all-reduce = 2(W-1)/W x
+    # result; gathers/a2a = (W-1)/W) — the reference's 1-bit claim is about
+    # exactly this physical traffic. The loss pmean's tiny f32[] all-reduce
+    # rides along in both phases.
+    result["reduction_x"] = round(
+        result["warmup"]["wire_total"]
+        / max(result["compressed"]["wire_total"], 1), 1)
+    print(json.dumps(result))
+    out = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "ONEBIT_WIRE.json")
+    with open(out, "w") as f:
+        json.dump(result, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

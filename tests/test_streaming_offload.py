@@ -339,3 +339,17 @@ def test_wire_bytes_accounting():
             nb = -(-n // scfg.wire_block)
             total += nb * scfg.wire_block + 4 * nb
     assert eng.wire_bytes_per_step() == 2 * total
+
+
+def test_fresh_init_streams_chunks_and_trains():
+    """No host_params: the engine generates each chunk on demand (the
+    path the multi-billion-param runs take — materializing the full fp32
+    pytree next to the Adam state would OOM the host)."""
+    cfg = tiny_cfg(dtype=jnp.bfloat16)
+    scfg = StreamConfig(micro_batch=B, seq=S, group_layers=2,
+                        wire_bits=4, warmup_steps=2, lr=3e-3)
+    eng = StreamedOffloadEngine(cfg, scfg)
+    assert eng.n_params > 0 and len(eng.chunk_names) == 3
+    losses = [eng.train_batch(t) for t in batch(n=10)]
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[-3:]) < losses[0], losses
