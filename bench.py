@@ -149,13 +149,23 @@ def main():
     # attention), produced by scripts/bert_sparse_bench.py; embedded only
     # when they were measured on the same platform as this run
     extra = None
-    extra_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                              "BENCH_EXTRA.json")
+    here = os.path.dirname(os.path.abspath(__file__))
+    extra_path = os.path.join(here, "BENCH_EXTRA.json")
     if os.path.isfile(extra_path):
         with open(extra_path) as f:
             candidate = json.load(f)
         if candidate.get("platform") == jax.devices()[0].platform:
             extra = candidate
+    # one-shot measured artifacts from their own hardware runs: the 6.65B
+    # single-chip ZeRO-Infinity streaming demo (scripts/infinity_stream.py)
+    # and the 1-bit Adam bytes-on-wire audit (scripts/onebit_wire_bytes.py)
+    for key, fname in (("zero_infinity_6p7b", "INFINITY_RUN.json"),
+                       ("onebit_wire", "ONEBIT_WIRE.json")):
+        p = os.path.join(here, fname)
+        if os.path.isfile(p):
+            with open(p) as f:
+                extra = dict(extra or {})
+                extra[key] = json.load(f)
 
     tokens_per_step = micro * gas * dp * seq
     tokens_per_sec_per_chip = tokens_per_step / dt / max(1, len(jax.devices()))
