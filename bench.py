@@ -164,8 +164,15 @@ def main():
         p = os.path.join(here, fname)
         if os.path.isfile(p):
             with open(p) as f:
+                candidate = json.load(f)
+            # same self-consistency rule as BENCH_EXTRA: only embed
+            # hardware artifacts into an output measured on that platform
+            # (artifacts lacking a platform field predate the tag — keep
+            # them TPU-gated since both producers are chip-only scripts)
+            art_platform = candidate.get("platform", "tpu")
+            if art_platform == jax.devices()[0].platform:
                 extra = dict(extra or {})
-                extra[key] = json.load(f)
+                extra[key] = candidate
 
     tokens_per_step = micro * gas * dp * seq
     tokens_per_sec_per_chip = tokens_per_step / dt / max(1, len(jax.devices()))

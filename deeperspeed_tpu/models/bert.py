@@ -17,6 +17,7 @@ fine-tuning starts.
 """
 
 import dataclasses
+import math
 from typing import Any, Optional
 
 import jax
@@ -46,6 +47,11 @@ class BertConfig:
     initializer_range: float = 0.02
     pre_layer_norm: bool = False  # classic BERT is post-LN
     remat: bool = True
+    # 'full' recomputes the whole layer in backward (min memory, ~+33%
+    # matmul flops); 'matmuls' saves the qkv / attention-ctx / pre-gelu
+    # matmul outputs so only the elementwise tail recomputes — the same
+    # selective policy the GPT flagship benches with (gpt.py remat_policy)
+    remat_policy: str = "full"
     dtype: Any = jnp.bfloat16
     attn_impl: str = "auto"
     attn_dropout: float = 0.0
@@ -53,6 +59,22 @@ class BertConfig:
     # MLM-loss sequence chunk (streaming CE, no (B,S,V) fp32 logits);
     # 0 disables chunking
     ce_chunk: int = 64
+    # when > 0, the MLM head runs only on scored positions: the (B*S)
+    # hidden rows are stably ordered scored-first and the head consumes the
+    # first ceil(frac*B*S) (lane-aligned) rows — at 15% masking the
+    # vocab-width matmul drops ~4x in flops. frac must upper-bound the true
+    # scored fraction: positions past the cut are silently unscored (the
+    # loss normalizer counts only gathered positions), so keep a margin
+    # (0.25 for standard 15% MLM). 0 = score every position (exact).
+    mlm_gather_frac: float = 0.0
+
+    def __post_init__(self):
+        if self.remat_policy not in ("full", "matmuls"):
+            raise ValueError(
+                f"remat_policy must be 'full' or 'matmuls', "
+                f"got {self.remat_policy!r}")
+        if not 0.0 <= self.mlm_gather_frac <= 1.0:
+            raise ValueError("mlm_gather_frac must be in [0, 1]")
 
     @property
     def ffn_dim(self):
@@ -175,7 +197,16 @@ def make_bert(cfg: BertConfig, mesh=None):
                                         attention_mask=additive,
                                         rng=layer_rng)
 
-        step = jax.checkpoint(block, prevent_cse=False) if cfg.remat else block
+        if cfg.remat:
+            policy = {
+                "full": None,
+                "matmuls": jax.checkpoint_policies.save_only_these_names(
+                    "bert_qkv", "bert_ctx", "bert_mlp_pre"
+                ),
+            }[cfg.remat_policy]
+            step = jax.checkpoint(block, prevent_cse=False, policy=policy)
+        else:
+            step = block
 
         def scan_body(carry, xs):
             layer_params, idx = xs
@@ -219,6 +250,24 @@ def make_bert(cfg: BertConfig, mesh=None):
         seq_out, _ = apply_fn(params, input_ids, attention_mask=attention_mask,
                               rng=rng)
         B, S, D = seq_out.shape
+        if cfg.mlm_gather_frac:
+            # run the vocab-width head only on scored positions: stable
+            # argsort orders scored rows first, the head consumes a
+            # lane-aligned prefix (see mlm_gather_frac docstring for the
+            # upper-bound contract)
+            BS = B * S
+            K = min(BS, int(math.ceil(cfg.mlm_gather_frac * BS / 128)) * 128)
+            flat_lab = labels.reshape(BS)
+            n_scored = jnp.sum(flat_lab != -100)
+            order = jnp.argsort(flat_lab == -100, stable=True)[:K]
+            seq_out = seq_out.reshape(BS, D)[order][None]
+            labels = flat_lab[order][None]
+            # overflow telemetry (MoE dropped_frac analog): positions past
+            # the cut are silently unscored, so surface the count to layer-
+            # output collectors instead of hiding it
+            hooks.record_layer_output(
+                "mlm_dropped", jnp.maximum(n_scored - K, 0))
+            B, S = 1, K
         chunk = pick_ce_chunk(S, cfg.ce_chunk)
         if chunk and S > chunk:
             n = S // chunk

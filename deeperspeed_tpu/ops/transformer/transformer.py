@@ -38,6 +38,7 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.ad_checkpoint import checkpoint_name
 
 from ..pallas.flash_attention import flash_attention, is_available
 
@@ -233,17 +234,23 @@ def _transformer_forward(params, x, config: DeepSpeedTransformerConfig,
     def attn_block(x):
         h = _layer_norm(x, p["attn_nw"], p["attn_nb"], eps) if config.pre_layer_norm else x
         qkv = h @ p["attn_qkvw"] + p["attn_qkvb"]
+        # named for selective remat (BertConfig.remat_policy='matmuls'):
+        # save the big matmul outputs so the backward recomputes only the
+        # cheap elementwise tail, not the MXU work
+        qkv = checkpoint_name(qkv, "bert_qkv")
         q, k, v = jnp.split(qkv, 3, axis=-1)
         shp = (B, S, nh, dh)
         ctx = _attention_core(q.reshape(shp), k.reshape(shp), v.reshape(shp),
                               config, attention_mask,
                               drop_rng=(r1 if config.attn_dropout_ratio > 0 else None))
+        ctx = checkpoint_name(ctx, "bert_ctx")
         out = ctx.reshape(B, S, H) @ p["attn_ow"] + p["attn_ob"]
         return _dropout(out, config.hidden_dropout_ratio, r2)
 
     def ffn_block(x):
         h = _layer_norm(x, p["norm_w"], p["norm_b"], eps) if config.pre_layer_norm else x
-        inter = jax.nn.gelu(h @ p["inter_w"] + p["inter_b"], approximate=False)
+        pre = checkpoint_name(h @ p["inter_w"] + p["inter_b"], "bert_mlp_pre")
+        inter = jax.nn.gelu(pre, approximate=False)
         out = inter @ p["output_w"] + p["output_b"]
         return _dropout(out, config.hidden_dropout_ratio, r3)
 

@@ -47,15 +47,22 @@ def peak_tflops():
     return PEAK["v5e"] if jax.devices()[0].platform == "tpu" else PEAK["cpu"]
 
 
-def bench_bert(seq: int, micro: int, steps: int, warmup: int):
-    """BERT-large MLM training step through the engine, ZeRO-2 + bf16."""
+def bench_bert(seq: int, micro: int, steps: int, warmup: int,
+               remat=False, remat_policy="matmuls", gather=0.25):
+    """BERT-large MLM training step through the engine, ZeRO-2 + bf16.
+
+    Default perf shape (round 3): no remat — 336M params + no-remat
+    activations fit the 16GB chip at these micro sizes, and full-layer
+    recompute was costing ~33% extra matmul flops; MLM head gathered to
+    scored positions only (15% masking under a 0.25 cut)."""
     import deeperspeed_tpu as ds
     from deeperspeed_tpu.models.bert import BertConfig, make_bert
 
     cfg = BertConfig(
         vocab_size=30528,  # padded to a lane multiple
         n_layer=24, n_head=16, d_model=1024, max_seq=seq,
-        dtype=jnp.bfloat16, remat=True, ce_chunk=64,
+        dtype=jnp.bfloat16, remat=remat, remat_policy=remat_policy,
+        ce_chunk=64, mlm_gather_frac=gather,
     )
     init_fn, _, mlm_loss_fn, _ = make_bert(cfg)
     params = init_fn(jax.random.PRNGKey(0))
@@ -110,7 +117,8 @@ def bench_bert(seq: int, micro: int, steps: int, warmup: int):
     }
 
 
-def bench_sparse_vs_dense(S: int, steps: int):
+def bench_sparse_vs_dense(S: int, steps: int, sparsity_cfg=None,
+                          skip_naive=False):
     """fwd+bwd attention core: block-sparse Pallas vs dense flash, BERT-
     large head geometry (16 heads x 64 dh)."""
     from deeperspeed_tpu.ops.pallas.flash_attention import (
@@ -123,10 +131,10 @@ def bench_sparse_vs_dense(S: int, steps: int):
     k = jax.random.normal(jax.random.PRNGKey(1), (B, H, S, Dh), jnp.bfloat16)
     v = jax.random.normal(jax.random.PRNGKey(2), (B, H, S, Dh), jnp.bfloat16)
 
-    sparse = SparseSelfAttention(
-        FixedSparsityConfig(num_heads=H, block=128,
-                            attention="unidirectional"),
-        max_seq_length=S)
+    if sparsity_cfg is None:
+        sparsity_cfg = FixedSparsityConfig(num_heads=H, block=128,
+                                           attention="unidirectional")
+    sparse = SparseSelfAttention(sparsity_cfg, max_seq_length=S, causal=True)
     layout = sparse.get_layout(S)
     density = float(layout.sum()) / layout.size
 
@@ -169,18 +177,21 @@ def bench_sparse_vs_dense(S: int, steps: int):
         p = jax.nn.softmax(s, axis=-1)
         return jnp.einsum("bhqk,bhkd->bhqd", p.astype(qh.dtype), vh)
 
-    t_naive = time_fn(naive)
-    return {
+    t_naive = None if skip_naive else time_fn(naive)
+    row = {
         "seq": S, "heads": H, "head_dim": Dh,
+        "layout": type(sparsity_cfg).__name__,
         "layout_density": round(density, 4),
-        "dense_naive_ms": round(t_naive * 1e3, 3),
         "dense_flash_ms": round(t_flash * 1e3, 3),
         "block_sparse_ms": round(t_sparse * 1e3, 3),
-        "speedup_vs_naive": round(t_naive / t_sparse, 2),
         "speedup_vs_flash": round(t_flash / t_sparse, 2),
         "reference_claim": ("up to 6.3x vs dense (V100, long sequences; "
                             "dense == materialized-softmax in 2020)"),
     }
+    if t_naive is not None:
+        row["dense_naive_ms"] = round(t_naive * 1e3, 3)
+        row["speedup_vs_naive"] = round(t_naive / t_sparse, 2)
+    return row
 
 
 def main():
@@ -199,10 +210,32 @@ def main():
         r = bench_bert(seq, micro, steps=steps, warmup=2)
         out["bert_large_zero2"].append(r)
         print(json.dumps(r), flush=True)
-    # S capped at 8192: the scalar-prefetched LUT (s32[H, nb, width]) lives
-    # in SMEM and exceeds it at nb=128 with the fixed pattern's width
-    for S in (4096, 8192):
-        r = bench_sparse_vs_dense(S, steps=4)
+    from deeperspeed_tpu.ops.sparse_attention import (
+        LocalSlidingWindowSparsityConfig)
+
+    H = 16
+    sweep = [
+        (4096, None),   # Fixed default — the r1/r2 comparison point
+        (8192, None),
+        # sliding-window sweep at S=8192: the VERDICT ~12.5%-density target
+        # (w14 = 11.8%) plus denser points to locate the sparse-vs-flash
+        # crossover density
+        (8192, LocalSlidingWindowSparsityConfig(
+            num_heads=H, block=128, num_sliding_window_blocks=14)),
+        (8192, LocalSlidingWindowSparsityConfig(
+            num_heads=H, block=128, num_sliding_window_blocks=24)),
+        (8192, LocalSlidingWindowSparsityConfig(
+            num_heads=H, block=128, num_sliding_window_blocks=32)),
+        (8192, LocalSlidingWindowSparsityConfig(
+            num_heads=H, block=128, num_sliding_window_blocks=40)),
+        # long-sequence point (the resident kernels lift the old streaming
+        # LUT's SMEM-width cap at this geometry)
+        (16384, LocalSlidingWindowSparsityConfig(
+            num_heads=H, block=128, num_sliding_window_blocks=14)),
+    ]
+    for S, scfg in sweep:
+        r = bench_sparse_vs_dense(S, steps=4, sparsity_cfg=scfg,
+                                  skip_naive=(S > 8192 or scfg is not None))
         out["sparse_vs_dense"].append(r)
         print(json.dumps(r), flush=True)
 

@@ -1,0 +1,192 @@
+"""MFU ceiling decomposition (VERDICT r2 weak #1/#3, next-round items 3/9).
+
+Answers "where do the missing MFU points live?" for the flagship GPT-NeoX
+1.3B and BERT-large bench shapes, by timing on the real chip:
+
+  matmuls   — every large matmul of one layer (+ the logits/MLM head) at
+              the exact bench shapes, fwd and fwd+bwd, standalone;
+  attn      — the attention core (flash or xla, whichever the model picks)
+              at model geometry, fwd+bwd;
+  step      — the full engine train_batch (same path as bench.py).
+
+It then reports a step-time floor = sum of constituent times (matmul chain
++ attention + head) against the measured step, attributing the MFU gap to
+(a) per-op inefficiency vs the chip's chained-matmul ceiling
+(MATMUL_CEILING.json methodology) and (b) everything-else (layernorms,
+rotary, remat recompute, optimizer, dispatch).
+
+Writes MFU_DECOMP.json. Usage:
+  python scripts/mfu_decomposition.py [--models 1.3b,bert128,bert512]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _time(fn, *args, reps=8, warmup=2):
+    """Best-of wall time of a jitted callable returning a scalar handle."""
+    jfn = jax.jit(fn)
+    for _ in range(warmup):
+        float(jax.device_get(jfn(*args)))
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = jfn(*args)
+        float(jax.device_get(out))
+        best = min(best, (time.perf_counter() - t0) / reps)
+    return best
+
+
+def _matmul_pair(M, K, N, reps=8):
+    """(fwd_s, fwdbwd_s, flops_fwd) for one bf16 (M,K)@(K,N)."""
+    a = jax.random.normal(jax.random.PRNGKey(0), (M, K), jnp.bfloat16)
+    w = jax.random.normal(jax.random.PRNGKey(1), (K, N), jnp.bfloat16)
+
+    def fwd(a, w):
+        return jnp.sum((a @ w).astype(jnp.float32))
+
+    def fwdbwd(a, w):
+        l, (ga, gw) = jax.value_and_grad(fwd, argnums=(0, 1))(a, w)
+        return l + jnp.sum(ga.astype(jnp.float32)) + jnp.sum(
+            gw.astype(jnp.float32))
+
+    return (_time(fwd, a, w, reps=reps), _time(fwdbwd, a, w, reps=reps),
+            2.0 * M * K * N)
+
+
+def _attn_core(B, H, S, Dh, causal, reps=4):
+    from deeperspeed_tpu.ops.pallas.flash_attention import (
+        flash_attention_bhsd, is_available)
+
+    q = jax.random.normal(jax.random.PRNGKey(0), (B, H, S, Dh), jnp.bfloat16)
+    k = jax.random.normal(jax.random.PRNGKey(1), (B, H, S, Dh), jnp.bfloat16)
+    v = jax.random.normal(jax.random.PRNGKey(2), (B, H, S, Dh), jnp.bfloat16)
+    use_flash = is_available(q.transpose(0, 2, 1, 3))
+
+    if use_flash:
+        core = lambda q, k, v: flash_attention_bhsd(q, k, v, causal=causal)
+    else:
+        def core(q, k, v):
+            s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                           preferred_element_type=jnp.float32) / (Dh ** 0.5)
+            if causal:
+                m = jnp.tril(jnp.ones((S, S), bool))
+                s = jnp.where(m[None, None], s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            return jnp.einsum("bhqk,bhkd->bhqd", p.astype(q.dtype), v)
+
+    def fwdbwd(q, k, v):
+        def loss(q, k, v):
+            return jnp.sum(core(q, k, v).astype(jnp.float32))
+        l, gs = jax.value_and_grad(loss, argnums=(0, 1, 2))(q, k, v)
+        return l + sum(jnp.sum(g.astype(jnp.float32)) for g in gs)
+
+    t = _time(fwdbwd, q, k, v, reps=reps)
+    # fwd 2 dots + bwd 5 dots ~= 3.5x fwd matmul flops; causal halves
+    flops = 3.5 * 2.0 * 2.0 * B * H * S * S * Dh * (0.5 if causal else 1.0)
+    return t, flops, ("flash" if use_flash else "xla")
+
+
+def peak_tflops():
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
+    table = {"v4": 275.0, "v5e": 197.0, "v5p": 459.0, "v6e": 918.0}
+    for kk, vv in table.items():
+        if gen.startswith(kk):
+            return vv
+    return 197.0 if jax.devices()[0].platform == "tpu" else 0.5
+
+
+def decompose(name):
+    """Per-component timing at the given bench geometry."""
+    if name == "1.3b":
+        D, Hh, L, S, micro, V = 2048, 16, 24, 2048, 2, 50304
+        causal, ffn_mult, head_rows = True, 4, micro * S
+        gas = 8
+    elif name == "bert128":
+        D, Hh, L, S, micro, V = 1024, 16, 24, 128, 64, 30528
+        causal, ffn_mult = False, 4
+        head_rows = 2048  # mlm_gather_frac=0.25 of 8192
+        gas = 1
+    elif name == "bert512":
+        D, Hh, L, S, micro, V = 1024, 16, 24, 512, 16, 30528
+        causal, ffn_mult = False, 4
+        head_rows = 2048
+        gas = 1
+    else:
+        raise ValueError(name)
+    M = micro * S
+    Dh = D // Hh
+    mm_shapes = {
+        "qkv": (M, D, 3 * D),
+        "attn_out": (M, D, D),
+        "ffn_in": (M, D, ffn_mult * D),
+        "ffn_out": (M, ffn_mult * D, D),
+    }
+    rows = {}
+    per_layer_fwdbwd = 0.0
+    per_layer_flops = 0.0
+    for k, (m, kk, n) in mm_shapes.items():
+        f, fb, fl = _matmul_pair(m, kk, n)
+        rows[k] = {"shape": [m, kk, n], "fwd_ms": round(f * 1e3, 3),
+                   "fwdbwd_ms": round(fb * 1e3, 3),
+                   "fwdbwd_tflops": round(3 * fl / fb / 1e12, 1)}
+        per_layer_fwdbwd += fb
+        per_layer_flops += 3 * fl
+    t_attn, fl_attn, attn_impl = _attn_core(micro, Hh, S, Dh, causal)
+    rows["attention_core"] = {
+        "impl": attn_impl, "geometry": [micro, Hh, S, Dh],
+        "fwdbwd_ms": round(t_attn * 1e3, 3),
+        "fwdbwd_tflops": round(fl_attn / t_attn / 1e12, 1),
+    }
+    f, fb, fl = _matmul_pair(head_rows, D, V, reps=4)
+    rows["logits_head"] = {"shape": [head_rows, D, V],
+                           "fwd_ms": round(f * 1e3, 3),
+                           "fwdbwd_ms": round(fb * 1e3, 3),
+                           "fwdbwd_tflops": round(3 * fl / fb / 1e12, 1)}
+
+    floor = (per_layer_fwdbwd + t_attn) * L + fb
+    floor_flops = (per_layer_flops + fl_attn) * L + 3 * fl
+    return {
+        "model": name,
+        "per_op": rows,
+        "micro_floor_s": round(floor, 4),
+        "micro_floor_tflops": round(floor_flops / floor / 1e12, 1),
+        "gas": gas,
+        "note": ("floor = L*(matmul chain + attention) + head, each timed "
+                 "standalone fwd+bwd; a full micro-step slower than this is "
+                 "paying for elementwise/remat/optimizer/dispatch; ops whose "
+                 "fwdbwd_tflops sit far under the MATMUL_CEILING.json number "
+                 "for their shape class are the per-op deficit"),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--models", default="1.3b,bert128,bert512")
+    ap.add_argument("--out", default=os.path.join(REPO, "MFU_DECOMP.json"))
+    args = ap.parse_args()
+    out = {"platform": jax.devices()[0].platform,
+           "device": str(jax.devices()[0].device_kind),
+           "peak_tflops": peak_tflops()}
+    for m in args.models.split(","):
+        out[m] = decompose(m.strip())
+        print(json.dumps(out[m]), flush=True)
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=1)
+    print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -110,8 +110,8 @@ def test_pld_trains():
 # shard masters/grads/params, and the curves must still agree.
 # ------------------------------------------------------------------ #
 
-LONG_STEPS = 150
-LONG_TAIL = 30
+LONG_STEPS = 300
+LONG_TAIL = 50
 ACTIVE = 96
 
 
@@ -124,11 +124,24 @@ def _chain_batch(rng, rows, seq):
     return np.concatenate(cols, axis=1).astype(np.int32)
 
 
-def _long_losses(extra, seed=0):
+def _long_losses(extra, seed=0, grad_drift=0.0):
     cfg = GPTConfig(vocab_size=256, n_layer=2, n_head=2, d_model=64,
                     max_seq=SEQ, remat=False, dtype=jnp.float32,
                     attn_impl="xla", rotary=True)
     init_fn, _, loss_fn, _ = make_gpt(cfg)
+    if grad_drift:
+        # deterministic update-path drift: grad += grad_drift * param on
+        # every leaf (an L2 term), the stand-in for a slow sharded-numerics
+        # bug; the reported loss stays the TRUE lm loss so the tail gate
+        # sees exactly what a drifting reduce-scatter would produce
+        base_loss_fn = loss_fn
+
+        def loss_fn(params, batch):
+            l2 = sum(jnp.sum(x.astype(jnp.float32) ** 2)
+                     for x in jax.tree_util.tree_leaves(params))
+            drift = 0.5 * grad_drift * l2
+            return base_loss_fn(params, batch) + (
+                drift - jax.lax.stop_gradient(drift))
     params = init_fn(jax.random.PRNGKey(seed))
     dcfg = {
         "train_micro_batch_size_per_gpu": MICRO,
@@ -167,6 +180,35 @@ def test_long_horizon_zero_matches_baseline(stage, long_baseline):
     tail = np.mean(losses[-LONG_TAIL:])
     assert abs(tail - base_tail) / max(base_tail, 0.25) < 0.02, (
         stage, tail, base_tail)
+
+
+def test_long_horizon_offload_matches_baseline(long_baseline):
+    """Sharded per-rank cpu-offloaded optimizer states, 300-step 2% gate."""
+    losses = _long_losses({
+        "zero_optimization": {"stage": 2,
+                              "offload_optimizer": {"device": "cpu"}},
+    })
+    base_tail = np.mean(long_baseline[-LONG_TAIL:])
+    tail = np.mean(losses[-LONG_TAIL:])
+    assert abs(tail - base_tail) / max(base_tail, 0.25) < 0.02, (
+        tail, base_tail)
+
+
+def test_long_horizon_gate_detects_1e3_grad_drift(long_baseline):
+    """Sensitivity proof for the 2% tail gate (VERDICT r2 weak #4): a
+    deliberate 1e-3-scale deterministic gradient perturbation — the
+    magnitude class of a real sharded-numerics drift — must TRIP the same
+    gate the parity tests use. The loss fed to the gate is the true lm
+    loss; only the gradients drift."""
+    losses = _long_losses({"zero_optimization": {"stage": 1}},
+                          grad_drift=1e-3)
+    base_tail = np.mean(long_baseline[-LONG_TAIL:])
+    tail = np.mean(losses[-LONG_TAIL:])
+    # same expression as the parity gate, inverted: the drifted run must
+    # NOT pass
+    assert abs(tail - base_tail) / max(base_tail, 0.25) >= 0.02, (
+        "1e-3 grad drift stayed inside the 2% gate: the gate cannot "
+        f"detect slow numeric drift (tail {tail} vs baseline {base_tail})")
 
 
 def test_long_horizon_masterless_bf16_tracks_fp32_master(long_baseline):
