@@ -335,7 +335,10 @@ def causal_attention(q, k, v, impl="auto"):
 
         if impl == "pallas_interpret":  # CPU testing path
             return flash_attention(q, k, v, causal=True, interpret=True)
-        if impl == "pallas" or is_available(q):
+        # auto avoids flash at short S: its per-(batch, head, q-block)
+        # dynamic k-loop overhead beats the compute there and XLA's
+        # batched-GEMM scores path is faster (hardware-measured at S<=256)
+        if impl == "pallas" or (is_available(q) and q.shape[1] > 256):
             return flash_attention(q, k, v, causal=True)
     return _xla_causal_attention(q, k, v)
 

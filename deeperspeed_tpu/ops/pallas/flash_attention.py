@@ -108,12 +108,13 @@ def is_available(q) -> bool:
         return False
     # full-sequence residency: the fwd/dQ kernels pin whole-S K and V in
     # VMEM and the dK/dV kernel pins whole-S Q and dO, so at large S the
-    # dominant tile is 2 * S * Dh in the input dtype. Budget it against
-    # ~2/3 of a v5e core's 16MB VMEM (leaving room for the scores tile,
-    # accumulators, and double-buffering); past that, ring/sparse/XLA
-    # attention take over.
+    # dominant tile is 2 * S * Dh in the input dtype. Hardware-measured
+    # cap (v5e, 16MB VMEM/core): 4MB of resident pair (S=16384, Dh=64,
+    # bf16) overflows scoped vmem by ~0.5MB once Mosaic double-buffers it
+    # across the head grid dim and adds the score tiles; 3.5MB compiles.
+    # Past this, ring/sparse/XLA attention take over.
     itemsize = q.dtype.itemsize if hasattr(q, "dtype") else 2
-    if 2 * S * Dh * itemsize > 10 * 1024 * 1024:
+    if 2 * S * Dh * itemsize > int(3.5 * 1024 * 1024):
         return False
     return True
 

@@ -153,7 +153,11 @@ def _group_flags(rows_ref, cols_ref, h, i, n_entries):
     starting at i*LANE; all share a row id by build_flat_lut construction).
 
     first/last derive from adjacent SMEM entries; `last` also fires when
-    the next group is global padding (col < 0 with the same row id)."""
+    the next group is global padding (col < 0 with the same row id), and is
+    additionally gated on this group being genuine (own first col >= 0) or
+    first-of-row (an empty row's single invalid group must still flush its
+    zero output) — so trailing global-padding groups do not redundantly
+    re-write the final row's output block every step."""
     base = i * LANE
     row = rows_ref[h, base]
     prev_row = rows_ref[h, jnp.maximum(base - 1, 0)]
@@ -162,6 +166,9 @@ def _group_flags(rows_ref, cols_ref, h, i, n_entries):
     last = jnp.logical_or(
         base + LANE >= n_entries,
         jnp.logical_or(rows_ref[h, nxt] != row, cols_ref[h, nxt] < 0),
+    )
+    last = jnp.logical_and(
+        last, jnp.logical_or(first, cols_ref[h, base] >= 0)
     )
     return row, first, last
 
@@ -517,6 +524,520 @@ def _bs_bwd(res, g, rows, cols, keys_t, qrows_t, sm_scale, block, causal,
     return dq, dk, dv
 
 
+# ================================================================== #
+# resident-K/V kernels (the fast path while 2*S*Dh fits VMEM, same
+# residency idea as ops/pallas/flash_attention.py)
+# ================================================================== #
+#
+# Design (v4, hardware-profiled). Three earlier shapes of this kernel
+# were bound by fixed costs, not flops. The decisive v5e measurement:
+# a dynamic-trip-count loop iteration carries ~6us of UNOVERLAPPED
+# scalar-core work (SMEM entry reads, dynamic-slice address math, loop
+# bookkeeping — Mosaic cannot software-pipeline dynamic while loops), so
+# kernel wall time ~= 6us x total iterations, for flash itself as much
+# as for any sparse variant (flash at Dh=64/S=8192 runs ~4k iterations
+# of (512 q x 512 k) tiles ~= 25ms regardless of anything else). A
+# sparse kernel beats flash iff it runs FEWER iterations, i.e. its
+# per-iteration tile must cover the same area while the LUT drops the
+# inactive area.
+#
+# v4 therefore processes one (SROW*block q-rows x CHUNK*block k-cols)
+# SUPER-TILE per iteration — the same 512x512 area as a flash iteration
+# at block=128 — selected by a flat per-super-row entry list built from
+# runs of the UNION of the tile rows' active blocks. Per-block activity
+# inside the super-tile travels as a 16-bit bitmap in the entry (bit
+# r*CHUNK+c), reconstructed in-kernel as a vector mask; union waste (a
+# row masked out of a neighbouring row's window) is ~20% for sliding-
+# window layouts and bounded by CHUNK x (SROW-1) blocks per run. The
+# online-softmax state lives in registers for the whole super-row and
+# flushes ONCE after the loop (static store — no per-entry flush, no
+# dummy entries, no rloc/last bookkeeping).
+
+CHUNK = 4   # k blocks per entry window: 512 cols at block=128
+SROW = 4    # q rows (key rows for dkdv) per super-tile: 512 at block=128
+
+
+def _pick_tile(nb: int, tile: int) -> int:
+    tile = min(tile, nb)
+    while nb % tile:
+        tile -= 1
+    return tile
+
+
+def build_super_lut(layout: np.ndarray, chunk: int, srow: int,
+                    causal: bool = False, transposed: bool = False):
+    """layout (H, nb, nb) 0/1 (pre-filtered to the lower block triangle by
+    the caller when causal) -> per-super-row entry lists.
+
+    Active columns are UNIONed over each super-row's `srow` rows, grouped
+    into runs of consecutive block ids, and split into windows of
+    <= `chunk` blocks (win clamped to nb - chunk so the kernel's
+    static-size dynamic slice never clips). Each entry carries win plus a
+    bitmap of which (row, col) blocks of the super-tile are genuinely
+    active (bit r*chunk + c); every active layout block lands in exactly
+    one entry because the windows partition the union runs.
+
+    Entries that need NO in-kernel mask — bitmap all-ones and, when
+    causal, the whole tile x window strictly below the diagonal (the
+    criterion flips for the dkdv kernel's transposed LUT) — sort FIRST;
+    nfull counts them, so the kernels run a lean flash-like loop over
+    [0, nfull) and pay the bitmap/causal mask only on [nfull, counts).
+
+    Returns wins, bitmaps (H, nsr, W) int32 and counts, nfull (H, nsr)
+    int32, nsr = nb/srow; entries past counts are never executed."""
+    lay = np.asarray(layout) != 0
+    H, nb, _ = lay.shape
+    chunk = min(chunk, nb)
+    nsr = nb // srow
+    per = []
+    W = 1
+    for h in range(H):
+        rows_h = []
+        for sr in range(nsr):
+            tile_rows = lay[h, sr * srow:(sr + 1) * srow]  # (srow, nb)
+            union = tile_rows.any(axis=0)
+            (idx,) = np.nonzero(union)
+            entries = []
+            i = 0
+            while i < len(idx):
+                j = i
+                while j + 1 < len(idx) and idx[j + 1] == idx[j] + 1:
+                    j += 1
+                a, b = int(idx[i]), int(idx[j])
+                while a <= b:
+                    seg = min(chunk, b - a + 1)
+                    win = min(a, nb - chunk)
+                    bm = 0
+                    for r in range(srow):
+                        for c in range(chunk):
+                            col = win + c
+                            # only the segment's own columns: windows
+                            # partition the union, clamp overlap included
+                            # once (by the first window that covers it)
+                            if a <= col <= a + seg - 1 and tile_rows[r, col]:
+                                bm |= 1 << (r * chunk + c)
+                    full_bm = (1 << (srow * chunk)) - 1
+                    if causal:
+                        below = (win >= (sr + 1) * srow if transposed
+                                 else sr * srow >= win + chunk)
+                    else:
+                        below = True
+                    entries.append((win, bm, bm == full_bm and below))
+                    a += seg
+                i = j + 1
+            # mask-free entries first (online softmax is order-invariant)
+            entries.sort(key=lambda e: not e[2])
+            rows_h.append(entries)
+            W = max(W, len(entries))
+        per.append(rows_h)
+    wins = np.zeros((H, nsr, W), np.int32)
+    bitmaps = np.zeros((H, nsr, W), np.int64)
+    counts = np.zeros((H, nsr), np.int32)
+    nfull = np.zeros((H, nsr), np.int32)
+    for h in range(H):
+        for sr in range(nsr):
+            es = per[h][sr]
+            counts[h, sr] = len(es)
+            nfull[h, sr] = sum(1 for e in es if e[2])
+            for j, (w, bm, _) in enumerate(es):
+                wins[h, sr, j] = w
+                bitmaps[h, sr, j] = bm
+    if srow * chunk <= 31:
+        bitmaps = bitmaps.astype(np.int32)
+    else:
+        # TPU SMEM scalars are int32: split into (lo, hi) row-half words
+        # (lo = rows [0, srow/2), hi = the rest), matching
+        # _super_mask_consts' hi_sel row split
+        half_bits = (srow // 2) * chunk
+        lo = (bitmaps & ((1 << half_bits) - 1)).astype(np.int32)
+        hi = (bitmaps >> half_bits).astype(np.int32)
+        bitmaps = np.stack([lo, hi], axis=-1)
+    return wins, bitmaps, counts, nfull
+
+
+def supertile_waste(layout: np.ndarray, chunk: int = None,
+                    srow: int = None) -> float:
+    """Ratio of super-tile-covered block area to genuinely active blocks —
+    the cost model behind impl='auto'. Window-family layouts (sliding,
+    longformer, bigbird) land near 1.2-1.5; STRIDED patterns (the Fixed
+    config's every-Nth-column globals) explode the union windows and land
+    3+, where the streaming kernels' narrow per-block gathers win on
+    hardware despite their per-step overhead."""
+    lay = np.asarray(layout) != 0
+    H, nb, _ = lay.shape
+    chunk = min(chunk or CHUNK, nb)
+    srow = _pick_tile(nb, srow or SROW)
+    nsr = nb // srow
+    union = lay.reshape(H, nsr, srow, nb).any(axis=2)
+    windows = 0
+    for h in range(H):
+        for sr in range(nsr):
+            (idx,) = np.nonzero(union[h, sr])
+            i = 0
+            while i < len(idx):
+                j = i
+                while j + 1 < len(idx) and idx[j + 1] == idx[j] + 1:
+                    j += 1
+                run = int(idx[j]) - int(idx[i]) + 1
+                windows += -(-run // chunk)
+                i = j + 1
+    active = int(lay.sum())
+    return windows * srow * chunk / max(active, 1)
+
+
+def resident_ok(S: int, Dh: int, itemsize: int = 2) -> bool:
+    """Whole-sequence VMEM residency budget: the fwd/dq kernels pin K+V,
+    dkdv pins Q+dO, and Mosaic double-buffers the resident pair across the
+    batch*head grid dim — hardware-measured on v5e (16MB VMEM/core), 4MB
+    of resident tensors (S=16384, Dh=64, bf16) overflows by 65KB once the
+    score tiles and output buffers are added, while 3MB fits. Beyond this
+    the streaming kernels take over (no VMEM cap on S)."""
+    return 2 * S * Dh * itemsize <= 3 * 1024 * 1024
+
+
+def _super_mask_consts(s_shape, sr, block, chunk, srow, transposed):
+    """Loop-INVARIANT pieces of the super-tile mask, hoisted out of the
+    dynamic entry loop (the VPU passes building iotas and the bit-index
+    matrix are identical for every entry of a super-row)."""
+    r_off = jax.lax.broadcasted_iota(jnp.int32, s_shape, 0)
+    c_off = jax.lax.broadcasted_iota(jnp.int32, s_shape, 1)
+    if transposed:
+        row_blk = c_off // block                  # key-row block index
+        col_blk = r_off // block                  # window block index
+        fixed_pos = sr * (srow * block) + c_off   # key positions
+        win_off = r_off                           # q offset inside window
+    else:
+        row_blk = r_off // block
+        col_blk = c_off // block
+        fixed_pos = sr * (srow * block) + r_off   # q positions
+        win_off = c_off                           # key offset inside window
+    if srow * chunk <= 31:
+        bit = row_blk * chunk + col_blk
+        hi_sel = None
+    else:
+        # >31-bit bitmaps travel as (lo, hi) words split at srow/2 rows
+        half = srow // 2
+        bit = (row_blk % half) * chunk + col_blk
+        hi_sel = row_blk >= half
+    return fixed_pos, win_off, bit, hi_sel
+
+
+def _super_mask(consts, win, bitmap, block, causal, transposed):
+    """Per-entry mask from the hoisted constants: one variable shift + one
+    compare for the bitmap, one add + compare for the causal triangle.
+    transposed=False: rows are the super-row's q rows, cols the window
+    (q-side kernels); True: rows are the window's q rows, cols the
+    super-row's KEY rows (dkdv kernel)."""
+    fixed_pos, win_off, bit, hi_sel = consts
+    if hi_sel is None:
+        bm = jnp.broadcast_to(bitmap, bit.shape)
+    else:
+        bm = jnp.where(hi_sel, jnp.broadcast_to(bitmap[1], bit.shape),
+                       jnp.broadcast_to(bitmap[0], bit.shape))
+    ok = (jax.lax.shift_right_logical(bm, bit) & 1) == 1
+    if causal:
+        win_pos = win * block + win_off
+        if transposed:
+            ok = ok & (win_pos >= fixed_pos)   # qpos >= kpos
+        else:
+            ok = ok & (fixed_pos >= win_pos)
+    return ok
+
+
+def _bm_read(bitmaps_ref, h, sr, j):
+    """Bitmap scalar(s) for entry j: a bare int32, or the (lo, hi) pair
+    when build_super_lut packed a >31-bit bitmap into a trailing dim."""
+    if len(bitmaps_ref.shape) == 4:
+        return (bitmaps_ref[h, sr, j, 0], bitmaps_ref[h, sr, j, 1])
+    return bitmaps_ref[h, sr, j]
+
+
+def _bs_fwd_kernel_res(wins_ref, bitmaps_ref, counts_ref, nfull_ref,
+                       q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale,
+                       block, chunk, srow, causal, num_heads):
+    h = pl.program_id(0) % num_heads
+    sr = pl.program_id(1)
+    width = block * chunk
+    span = block * srow
+    Dh = q_ref.shape[-1]
+    q = q_ref[0]  # (span, Dh) — static block, loop-invariant
+    m0 = jnp.full((span,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((span,), jnp.float32)
+    a0 = jnp.zeros((span, Dh), jnp.float32)
+    consts = _super_mask_consts((span, width), sr, block, chunk, srow,
+                                False)
+
+    def make_body(masked):
+        def body(j, carry):
+            m, l, acc = carry
+            win = wins_ref[h, sr, j]
+            k = k_ref[0, pl.ds(win * block, width), :]
+            v = v_ref[0, pl.ds(win * block, width), :]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * sm_scale  # (span, width) fp32
+            if masked:
+                ok = _super_mask(consts, win,
+                                 _bm_read(bitmaps_ref, h, sr, j), block,
+                                 causal, False)
+                s = jnp.where(ok, s, NEG_INF)
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+            if masked:
+                # rows inactive in this entry keep m = -inf; clamp the
+                # subtrahend and kill p so exp(-1e30 - -1e30) = 1 cannot
+                # poison l (a mask-free entry has every score finite)
+                m_safe = jnp.maximum(m_new, NEG_INF * 0.5)
+                alive = (m_new > NEG_INF * 0.5).astype(jnp.float32)
+                p = jnp.exp(s - m_safe[:, None]) * alive[:, None]
+            else:
+                p = jnp.exp(s - m_new[:, None])
+            alpha = jnp.exp(jnp.minimum(m - m_new, 0.0))
+            l_new = l * alpha + jnp.sum(p, axis=-1)
+            acc_new = acc * alpha[:, None] + jax.lax.dot_general(
+                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            return m_new, l_new, acc_new
+
+        return body
+
+    nf = nfull_ref[h, sr]
+    carry = jax.lax.fori_loop(0, nf, make_body(False), (m0, l0, a0))
+    m, l, acc = jax.lax.fori_loop(nf, counts_ref[h, sr], make_body(True),
+                                  carry)
+    l_safe = jnp.where(l == 0.0, 1.0, l)  # empty rows -> zero output
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[0, 0] = jnp.where(
+        l == 0.0, NEG_INF, jnp.maximum(m, NEG_INF * 0.5) + jnp.log(l_safe))
+
+
+def _bs_bwd_dq_kernel_res(wins_ref, bitmaps_ref, counts_ref, nfull_ref,
+                          q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dq_ref, *, sm_scale, block, chunk, srow, causal,
+                          num_heads):
+    h = pl.program_id(0) % num_heads
+    sr = pl.program_id(1)
+    width = block * chunk
+    Dh = q_ref.shape[-1]
+    q = q_ref[0]
+    do = do_ref[0]
+    lse = lse_ref[0, 0]      # (span,); -inf on empty rows — clamp below
+    delta = delta_ref[0, 0]
+    lse_safe = jnp.maximum(lse, NEG_INF * 0.5)
+    consts = _super_mask_consts((q.shape[0], width), sr, block, chunk,
+                                srow, False)
+
+    def make_body(masked):
+        def body(j, dq):
+            win = wins_ref[h, sr, j]
+            k = k_ref[0, pl.ds(win * block, width), :]
+            v = v_ref[0, pl.ds(win * block, width), :]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * sm_scale
+            if masked:
+                ok = _super_mask(consts, win,
+                                 _bm_read(bitmaps_ref, h, sr, j), block,
+                                 causal, False)
+                s = jnp.where(ok, s, NEG_INF)
+            p = jnp.exp(s - lse_safe[:, None])
+            dp = jax.lax.dot_general(
+                do, v, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            ds = p * (dp - delta[:, None]) * sm_scale
+            return dq + jax.lax.dot_general(
+                ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+        return body
+
+    nf = nfull_ref[h, sr]
+    dq = jax.lax.fori_loop(0, nf, make_body(False),
+                           jnp.zeros(q.shape, jnp.float32))
+    dq = jax.lax.fori_loop(nf, counts_ref[h, sr], make_body(True), dq)
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _bs_bwd_dkdv_kernel_res(wins_ref, bitmaps_ref, counts_ref, nfull_ref,
+                            k_ref, v_ref, q_ref, do_ref, lse_ref,
+                            delta_ref, dk_ref, dv_ref, *, sm_scale, block,
+                            chunk, srow, causal, num_heads):
+    """Transposed super-tiles: per KEY super-row, windows of attending q
+    blocks, dynamic-sliced from whole-sequence-resident Q/dO/lse/delta."""
+    h = pl.program_id(0) % num_heads
+    sr = pl.program_id(1)
+    width = block * chunk
+    Dh = k_ref.shape[-1]
+    k = k_ref[0]   # (span, Dh) key super-tile
+    v = v_ref[0]
+    span = k.shape[0]
+    consts = _super_mask_consts((width, span), sr, block, chunk, srow,
+                                True)
+
+    def make_body(masked):
+      def body(j, carry):
+        dk, dv = carry
+        win = wins_ref[h, sr, j]
+        qc = q_ref[0, pl.ds(win * block, width), :]   # (width, Dh)
+        doc = do_ref[0, pl.ds(win * block, width), :]
+        lsec = lse_ref[0, 0, pl.ds(win * block, width)]
+        deltac = delta_ref[0, 0, pl.ds(win * block, width)]
+        s = jax.lax.dot_general(
+            qc, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale  # (width, span)
+        if masked:
+            ok = _super_mask(consts, win, _bm_read(bitmaps_ref, h, sr, j),
+                             block, causal, True)
+            s = jnp.where(ok, s, NEG_INF)
+        # window rows can be EMPTY q rows (lse = -inf): clamp so
+        # exp(-1e30 - -1e30) = 1 cannot leak into dk/dv
+        p = jnp.exp(s - jnp.maximum(lsec, NEG_INF * 0.5)[:, None])
+        dv_new = dv + jax.lax.dot_general(
+            p.astype(doc.dtype), doc, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            doc, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - deltac[:, None]) * sm_scale
+        dk_new = dk + jax.lax.dot_general(
+            ds.astype(qc.dtype), qc, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return dk_new, dv_new
+
+      return body
+
+    z = jnp.zeros(k.shape[:1] + (Dh,), jnp.float32)
+    nf = nfull_ref[h, sr]
+    carry = jax.lax.fori_loop(0, nf, make_body(False), (z, z))
+    dk, dv = jax.lax.fori_loop(nf, counts_ref[h, sr], make_body(True),
+                               carry)
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _res_pallas_call(kernel, grid, in_specs, out_specs, out_shape,
+                     interpret, n_prefetch=4):
+    if pltpu is None:  # pragma: no cover
+        raise RuntimeError(
+            "Pallas TPU namespace unavailable; use the XLA fallback "
+            "(block_sparse_attention_xla)"
+        )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=n_prefetch,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
+    )
+    # no cross-step state: both grid dims reorder/pipeline freely
+    kwargs = _compiler_params(interpret, 2, ("parallel", "parallel"))
+    return pl.pallas_call(
+        kernel, grid_spec=grid_spec, out_shape=out_shape,
+        interpret=interpret, **kwargs,
+    )
+
+
+def _bs_fwd_res(q, k, v, lut, sm_scale, block, chunk, causal, srow,
+                interpret):
+    B, S, H, Dh = q.shape
+    qf = q.transpose(0, 2, 1, 3).reshape(B * H, S, Dh)
+    kf = k.transpose(0, 2, 1, 3).reshape(B * H, S, Dh)
+    vf = v.transpose(0, 2, 1, 3).reshape(B * H, S, Dh)
+    nsr = (S // block) // srow
+    span = srow * block
+    kernel = functools.partial(
+        _bs_fwd_kernel_res, sm_scale=sm_scale, block=block, chunk=chunk,
+        srow=srow, causal=causal, num_heads=H,
+    )
+    blk = lambda b, i, *_: (b, i, 0)
+    o, lse = _res_pallas_call(
+        kernel,
+        grid=(B * H, nsr),
+        in_specs=[
+            _vmem_spec((1, span, Dh), blk),
+            _vmem_spec((1, S, Dh), lambda b, i, *_: (b, 0, 0)),
+            _vmem_spec((1, S, Dh), lambda b, i, *_: (b, 0, 0)),
+        ],
+        out_specs=[
+            _vmem_spec((1, span, Dh), blk),
+            _vmem_spec((1, 1, span), lambda b, i, *_: (b, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, S, Dh), q.dtype),
+            jax.ShapeDtypeStruct((B * H, 1, S), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*lut, qf, kf, vf)
+    return o, lse, (qf, kf, vf)
+
+
+def _bs_bwd_res(res, g, lut, lut_t, sm_scale, block, chunk, causal, srow,
+                interpret, num_heads):
+    qf, kf, vf, o, lse = res
+    BH, S, Dh = qf.shape
+    H = num_heads
+    nsr = (S // block) // srow
+    span = srow * block
+    do = g
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    delta = delta.reshape(BH, 1, S)
+    blk = lambda b, i, *_: (b, i, 0)
+    row1 = lambda b, i, *_: (b, 0, i)
+    full = lambda b, i, *_: (b, 0, 0)
+
+    dq = _res_pallas_call(
+        functools.partial(
+            _bs_bwd_dq_kernel_res, sm_scale=sm_scale, block=block,
+            chunk=chunk, srow=srow, causal=causal, num_heads=H,
+        ),
+        grid=(BH, nsr),
+        in_specs=[
+            _vmem_spec((1, span, Dh), blk),    # q
+            _vmem_spec((1, S, Dh), full),      # k resident
+            _vmem_spec((1, S, Dh), full),      # v resident
+            _vmem_spec((1, span, Dh), blk),    # do
+            _vmem_spec((1, 1, span), row1),    # lse
+            _vmem_spec((1, 1, span), row1),    # delta
+        ],
+        out_specs=_vmem_spec((1, span, Dh), blk),
+        out_shape=jax.ShapeDtypeStruct((BH, S, Dh), qf.dtype),
+        interpret=interpret,
+    )(*lut, qf, kf, vf, do, lse, delta)
+
+    dk, dv = _res_pallas_call(
+        functools.partial(
+            _bs_bwd_dkdv_kernel_res, sm_scale=sm_scale, block=block,
+            chunk=chunk, srow=srow, causal=causal, num_heads=H,
+        ),
+        grid=(BH, nsr),
+        in_specs=[
+            _vmem_spec((1, span, Dh), blk),    # k super-tile
+            _vmem_spec((1, span, Dh), blk),    # v super-tile
+            _vmem_spec((1, S, Dh), full),      # q resident
+            _vmem_spec((1, S, Dh), full),      # do resident
+            _vmem_spec((1, 1, S), full),       # lse
+            _vmem_spec((1, 1, S), full),       # delta
+        ],
+        out_specs=[
+            _vmem_spec((1, span, Dh), blk),
+            _vmem_spec((1, span, Dh), blk),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, S, Dh), qf.dtype),
+            jax.ShapeDtypeStruct((BH, S, Dh), qf.dtype),
+        ],
+        interpret=interpret,
+    )(*lut_t, kf, vf, qf, do, lse, delta)
+    return dq, dk, dv
+
+
 # ------------------------------------------------------------------ #
 # public factory
 # ------------------------------------------------------------------ #
@@ -524,45 +1045,115 @@ def _bs_bwd(res, g, rows, cols, keys_t, qrows_t, sm_scale, block, causal,
 
 def make_block_sparse_attention(layout: np.ndarray, block: int,
                                 causal: bool = False, sm_scale: float = None,
-                                interpret: bool = False):
+                                interpret: bool = False, impl: str = "auto"):
     """Compile-ready block-sparse attention for a FIXED layout.
 
     layout: (H, nb, nb) 0/1 numpy array; returns fn(q, k, v) on (B, S, H, Dh)
     with S == nb * block. The layout and its LUTs are baked into the
     computation as constants (they are static configuration, like the
-    reference's cached triton ops per seq-len)."""
+    reference's cached triton ops per seq-len).
+
+    impl: "auto" picks the flash-style resident-K/V kernels while the
+    whole-sequence tensors fit the VMEM budget (resident_ok) and falls back
+    to the LUT-streaming kernels beyond; "resident"/"stream" force a path
+    (benchmarks, tests)."""
     layout = np.asarray(layout)
     H, nb, _ = layout.shape
+    if impl not in ("auto", "resident", "stream"):
+        raise ValueError(f"impl must be auto|resident|stream, got {impl!r}")
     # LUTs stay NUMPY: converting to jnp here would capture a tracer when
     # the factory is first invoked inside someone else's jit trace (ops are
     # cached per seq-len — a cached tracer poisons every later call with
     # UnexpectedTracerError). numpy constants bind safely into any trace.
-    rows, cols = build_flat_lut(layout, lane=LANE)
-    keys_t, qrows_t = build_flat_lut(layout.transpose(0, 2, 1), lane=LANE)
+    # Built LAZILY per path: the host-side per-row python loops are ~O(nnz)
+    # and only the path actually traced should pay them.
+    chunk = min(CHUNK, nb)
+    srow = _pick_tile(nb, SROW)
+    _luts = {}
+
+    def _stream_luts():
+        if "stream" not in _luts:
+            _luts["stream"] = (
+                build_flat_lut(layout, lane=LANE),
+                build_flat_lut(layout.transpose(0, 2, 1), lane=LANE),
+            )
+        return _luts["stream"]
+
+    def _resident_luts():
+        if "resident" not in _luts:
+            # single causal-filter site: fwd/dq and (transposed) dkdv LUTs
+            # both derive from this one filtered layout, so their masking
+            # can never desynchronize
+            lay_c = layout != 0
+            if causal:
+                lay_c = lay_c & np.tril(np.ones((nb, nb), bool))[None]
+            _luts["resident"] = (
+                build_super_lut(lay_c, chunk, srow, causal),
+                build_super_lut(lay_c.transpose(0, 2, 1), chunk, srow,
+                                causal, transposed=True),
+            )
+        return _luts["resident"]
+
+    _waste = [None]
+
+    def _use_resident(S, Dh, dtype):
+        if impl == "resident":
+            return True
+        if impl == "stream":
+            return False
+        if not resident_ok(S, Dh, jnp.dtype(dtype).itemsize):
+            return False
+        if _waste[0] is None:
+            lay_c = layout != 0
+            if causal:
+                lay_c = lay_c & np.tril(np.ones((nb, nb), bool))[None]
+            _waste[0] = supertile_waste(lay_c, chunk, srow)
+        return _waste[0] <= 2.0
 
     @jax.custom_vjp
     def attend(q, k, v):
         scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
-        o, _, _ = _bs_fwd(q, k, v, rows, cols, scale, block, causal, interpret)
         B, S, _, Dh = q.shape
+        if _use_resident(S, Dh, q.dtype):
+            o, _, _ = _bs_fwd_res(q, k, v, _resident_luts()[0], scale,
+                                  block, chunk, causal, srow, interpret)
+        else:
+            rows, cols = _stream_luts()[0]
+            o, _, _ = _bs_fwd(q, k, v, rows, cols, scale, block, causal,
+                              interpret)
         return o.reshape(B, H, S, Dh).transpose(0, 2, 1, 3)
 
     def fwd(q, k, v):
         scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
-        o, lse, (qf, kf, vf) = _bs_fwd(
-            q, k, v, rows, cols, scale, block, causal, interpret
-        )
         B, S, _, Dh = q.shape
+        if _use_resident(S, Dh, q.dtype):
+            o, lse, (qf, kf, vf) = _bs_fwd_res(
+                q, k, v, _resident_luts()[0], scale, block, chunk, causal,
+                srow, interpret
+            )
+        else:
+            rows, cols = _stream_luts()[0]
+            o, lse, (qf, kf, vf) = _bs_fwd(
+                q, k, v, rows, cols, scale, block, causal, interpret
+            )
         out = o.reshape(B, H, S, Dh).transpose(0, 2, 1, 3)
         return out, (qf, kf, vf, o, lse, scale, (B, S, H, Dh))
 
     def bwd(res, g):
         qf, kf, vf, o, lse, scale, (B, S, H_, Dh) = res
         gf = g.transpose(0, 2, 1, 3).reshape(B * H_, S, Dh)
-        dq, dk, dv = _bs_bwd(
-            (qf, kf, vf, o, lse), gf, rows, cols, keys_t, qrows_t, scale,
-            block, causal, interpret, H_,
-        )
+        if _use_resident(S, Dh, qf.dtype):
+            lut_res, lut_res_t = _resident_luts()
+            dq, dk, dv = _bs_bwd_res(
+                (qf, kf, vf, o, lse), gf, lut_res, lut_res_t, scale, block,
+                chunk, causal, srow, interpret, H_,
+            )
+        else:
+            (rows, cols), (keys_t, qrows_t) = _stream_luts()
+            dq, dk, dv = _bs_bwd(
+                (qf, kf, vf, o, lse), gf, rows, cols, keys_t, qrows_t,
+                scale, block, causal, interpret, H_,
+            )
         unflat = lambda x: x.reshape(B, H_, S, Dh).transpose(0, 2, 1, 3)
         return unflat(dq), unflat(dk), unflat(dv)
 

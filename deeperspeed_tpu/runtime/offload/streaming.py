@@ -557,7 +557,8 @@ class StreamedOffloadEngine:
             # leaves stay bf16 — an fp32 copy plus its fp32 gradient is a
             # ~1.7 GB transient at 6.7B scale that the chip cannot spare,
             # and the int4 wire noise dwarfs one bf16 rounding anyway.
-            # f_embed_bwd upcasts the wte grad to fp32 for the scatter-add.
+            # f_embed_bwd later merges the token-gather grads into this
+            # bf16 head grad in place (fp32 segment-pre-accumulated).
             gl32 = dict(gl)
             gl32["final_ln"] = jax.tree.map(
                 lambda a: a.astype(jnp.float32), gl["final_ln"])
@@ -578,15 +579,43 @@ class StreamedOffloadEngine:
             """Token-embedding scatter grad merged with the head/final_ln
             grads from the loss jit; quantized as the 'globals' chunk."""
             B, Sq, D = dx0.shape
-            d_wte = d_gl_head["embed"]["wte"].astype(jnp.float32)
-            d_wte = d_wte.at[tokens.reshape(-1)].add(
-                dx0.reshape(-1, D).astype(jnp.float32))
+            # The (V, D) table grad accumulates in the grad's own dtype
+            # (bf16), IN PLACE via the donated head grad: upcasting to fp32
+            # here cost an extra 824MB at 6.7B scale and OOMed the chip at
+            # 13.3GB resident params. Naive bf16 scatter-add would
+            # systematically truncate high-frequency tokens (once a row is
+            # >256x one increment, further adds round to zero), so the
+            # per-token contributions are pre-accumulated in fp32 over the
+            # (T, D) batch — sort by token id, segment-sum via cumsum —
+            # and each table row receives exactly ONE nonzero bf16 add of
+            # its full-precision sum: a single rounding, subordinate to
+            # the int4 wire quantization this grad undergoes next.
+            d_wte = d_gl_head["embed"]["wte"]
+            T = B * Sq
+            ids = tokens.reshape(T)
+            perm = jnp.argsort(ids)
+            ids_s = ids[perm]
+            vals = dx0.reshape(T, D).astype(jnp.float32)[perm]
+            csum = jnp.cumsum(vals, axis=0)
+            newrun = ids_s[1:] != ids_s[:-1]
+            first = jnp.concatenate([jnp.ones((1,), bool), newrun])
+            last = jnp.concatenate([newrun, jnp.ones((1,), bool)])
+            pos = jnp.arange(T)
+            # index of each position's run start: running max of marked
+            # start positions
+            start = jax.lax.associative_scan(
+                jnp.maximum, jnp.where(first, pos, 0))
+            prev = jnp.where(start[:, None] > 0,
+                             csum[jnp.maximum(start - 1, 0)], 0.0)
+            run_sum = jnp.where(last[:, None], csum - prev, 0.0)
+            d_wte = d_wte.at[ids_s].add(run_sum.astype(d_wte.dtype))
             d_embed = dict(d_gl_head["embed"])
             d_embed["wte"] = d_wte
             if not cfg.rotary:
-                d_wpe = d_gl_head["embed"]["wpe"].astype(jnp.float32)
+                d_wpe = d_gl_head["embed"]["wpe"]
                 d_wpe = d_wpe.at[:Sq].add(
-                    jnp.sum(dx0, axis=0).astype(jnp.float32))
+                    jnp.sum(dx0.astype(jnp.float32), axis=0)
+                    .astype(d_wpe.dtype))
                 d_embed["wpe"] = d_wpe
             d_gl = dict(d_gl_head)
             d_gl["embed"] = d_embed

@@ -48,13 +48,16 @@ def peak_tflops():
 
 
 def bench_bert(seq: int, micro: int, steps: int, warmup: int,
-               remat=False, remat_policy="matmuls", gather=0.25):
+               remat=True, remat_policy="full", gather=0.0):
     """BERT-large MLM training step through the engine, ZeRO-2 + bf16.
 
-    Default perf shape (round 3): no remat — 336M params + no-remat
-    activations fit the 16GB chip at these micro sizes, and full-layer
-    recompute was costing ~33% extra matmul flops; MLM head gathered to
-    scored positions only (15% masking under a 0.25 cut)."""
+    Perf config (round 3, within-process A/B on the chip): attn_impl
+    'auto' now resolves to the XLA batched-GEMM attention at S <= 256
+    (flash's dynamic-loop overhead dominated at seq 128: +27% end-to-end
+    from the switch, seq 512 keeps flash); full remat beat the 'matmuls'
+    selective policy (the save barriers inhibit fusion at these small
+    per-layer shapes) and the scored-position head gather was neutral, so
+    both stay at their model defaults here."""
     import deeperspeed_tpu as ds
     from deeperspeed_tpu.models.bert import BertConfig, make_bert
 
@@ -118,7 +121,7 @@ def bench_bert(seq: int, micro: int, steps: int, warmup: int,
 
 
 def bench_sparse_vs_dense(S: int, steps: int, sparsity_cfg=None,
-                          skip_naive=False):
+                          skip_naive=False, impl="auto"):
     """fwd+bwd attention core: block-sparse Pallas vs dense flash, BERT-
     large head geometry (16 heads x 64 dh)."""
     from deeperspeed_tpu.ops.pallas.flash_attention import (
@@ -134,7 +137,8 @@ def bench_sparse_vs_dense(S: int, steps: int, sparsity_cfg=None,
     if sparsity_cfg is None:
         sparsity_cfg = FixedSparsityConfig(num_heads=H, block=128,
                                            attention="unidirectional")
-    sparse = SparseSelfAttention(sparsity_cfg, max_seq_length=S, causal=True)
+    sparse = SparseSelfAttention(sparsity_cfg, max_seq_length=S, causal=True,
+                                 impl=impl)
     layout = sparse.get_layout(S)
     density = float(layout.sum()) / layout.size
 
@@ -162,9 +166,15 @@ def bench_sparse_vs_dense(S: int, steps: int, sparsity_cfg=None,
             best = min(best, time.perf_counter() - t0)
         return best / steps
 
+    from deeperspeed_tpu.ops.pallas.flash_attention import is_available
+
     t_sparse = time_fn(lambda q, k, v: sparse(q, k, v))
-    t_flash = time_fn(
-        lambda q, k, v: flash_attention_bhsd(q, k, v, causal=True))
+    # flash itself VMEM-caps out at ~4MB of resident K+V (is_available);
+    # beyond that the sparse kernel is the only fused option at this
+    # geometry — report sparse absolute time with the cap noted
+    flash_ok = is_available(q.transpose(0, 2, 1, 3))
+    t_flash = (time_fn(lambda q, k, v: flash_attention_bhsd(
+        q, k, v, causal=True)) if flash_ok else None)
 
     def naive(qh, kh, vh):
         # materialized S x S softmax — the kind of dense attention the
@@ -182,12 +192,15 @@ def bench_sparse_vs_dense(S: int, steps: int, sparsity_cfg=None,
         "seq": S, "heads": H, "head_dim": Dh,
         "layout": type(sparsity_cfg).__name__,
         "layout_density": round(density, 4),
-        "dense_flash_ms": round(t_flash * 1e3, 3),
         "block_sparse_ms": round(t_sparse * 1e3, 3),
-        "speedup_vs_flash": round(t_flash / t_sparse, 2),
         "reference_claim": ("up to 6.3x vs dense (V100, long sequences; "
                             "dense == materialized-softmax in 2020)"),
     }
+    if t_flash is not None:
+        row["dense_flash_ms"] = round(t_flash * 1e3, 3)
+        row["speedup_vs_flash"] = round(t_flash / t_sparse, 2)
+    else:
+        row["dense_flash"] = "VMEM-capped at this S*Dh (is_available)"
     if t_naive is not None:
         row["dense_naive_ms"] = round(t_naive * 1e3, 3)
         row["speedup_vs_naive"] = round(t_naive / t_sparse, 2)
@@ -228,14 +241,21 @@ def main():
             num_heads=H, block=128, num_sliding_window_blocks=32)),
         (8192, LocalSlidingWindowSparsityConfig(
             num_heads=H, block=128, num_sliding_window_blocks=40)),
-        # long-sequence point (the resident kernels lift the old streaming
-        # LUT's SMEM-width cap at this geometry)
+        # long-sequence point: past the resident kernels' VMEM budget the
+        # STREAMING kernels serve it — fused sparse attention at a length
+        # where flash itself is VMEM-capped out entirely
         (16384, LocalSlidingWindowSparsityConfig(
             num_heads=H, block=128, num_sliding_window_blocks=14)),
     ]
     for S, scfg in sweep:
-        r = bench_sparse_vs_dense(S, steps=4, sparsity_cfg=scfg,
-                                  skip_naive=(S > 8192 or scfg is not None))
+        # steps=16: the harness carries a measured ~5ms fixed cost per scan
+        # iteration through the tunnel; short scans bias ratios toward 1
+        try:
+            r = bench_sparse_vs_dense(S, steps=16, sparsity_cfg=scfg,
+                                      skip_naive=(S > 8192
+                                                  or scfg is not None))
+        except Exception as e:  # noqa: BLE001 — keep the sweep's survivors
+            r = {"seq": S, "error": f"{type(e).__name__}: {str(e)[:200]}"}
         out["sparse_vs_dense"].append(r)
         print(json.dumps(r), flush=True)
 

@@ -34,141 +34,158 @@ import numpy as np  # noqa: E402
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _time(fn, *args, reps=8, warmup=2):
-    """Best-of wall time of a jitted callable returning a scalar handle."""
-    jfn = jax.jit(fn)
-    for _ in range(warmup):
-        float(jax.device_get(jfn(*args)))
+
+def _time_unit(unit_loss, args, flops_per_exec, chain=4, iters=6):
+    """fwd+bwd time per execution of `unit_loss(*args) -> scalar`:
+    each scan iteration runs `chain` dependent executions (x perturbed by
+    the previous gradient, so nothing hoists), sized so per-iteration work
+    dwarfs the axon tunnel's ~5ms fixed per-iteration cost; flops are
+    counted as 3x forward (dgrad + wgrad)."""
+    x0 = args[0]
+
+    def one(x, *rest):
+        l, gs = jax.value_and_grad(unit_loss, argnums=tuple(
+            range(len(args))))(x, *rest)
+        gx = gs[0]
+        rest = sum((jnp.sum(g.astype(jnp.float32)) for g in gs[1:]),
+                   jnp.float32(0.0))
+        return (x + (1e-3 * gx).astype(x.dtype)
+                + (1e-9 * rest).astype(x.dtype)), l
+
+    @jax.jit
+    def loss(x, *rest):
+        def body(c, _):
+            x = c
+            for _ in range(chain):
+                x, _l = one(x, *rest)
+            return x, None
+
+        out, _ = jax.lax.scan(body, x, None, length=iters)
+        return jnp.sum(out.astype(jnp.float32))
+
+    float(jax.device_get(loss(*args)))
     best = float("inf")
-    for _ in range(3):
+    for i in range(3):
         t0 = time.perf_counter()
-        for _ in range(reps):
-            out = jfn(*args)
-        float(jax.device_get(out))
-        best = min(best, (time.perf_counter() - t0) / reps)
-    return best
-
-
-def _matmul_pair(M, K, N, reps=8):
-    """(fwd_s, fwdbwd_s, flops_fwd) for one bf16 (M,K)@(K,N)."""
-    a = jax.random.normal(jax.random.PRNGKey(0), (M, K), jnp.bfloat16)
-    w = jax.random.normal(jax.random.PRNGKey(1), (K, N), jnp.bfloat16)
-
-    def fwd(a, w):
-        return jnp.sum((a @ w).astype(jnp.float32))
-
-    def fwdbwd(a, w):
-        l, (ga, gw) = jax.value_and_grad(fwd, argnums=(0, 1))(a, w)
-        return l + jnp.sum(ga.astype(jnp.float32)) + jnp.sum(
-            gw.astype(jnp.float32))
-
-    return (_time(fwd, a, w, reps=reps), _time(fwdbwd, a, w, reps=reps),
-            2.0 * M * K * N)
-
-
-def _attn_core(B, H, S, Dh, causal, reps=4):
-    from deeperspeed_tpu.ops.pallas.flash_attention import (
-        flash_attention_bhsd, is_available)
-
-    q = jax.random.normal(jax.random.PRNGKey(0), (B, H, S, Dh), jnp.bfloat16)
-    k = jax.random.normal(jax.random.PRNGKey(1), (B, H, S, Dh), jnp.bfloat16)
-    v = jax.random.normal(jax.random.PRNGKey(2), (B, H, S, Dh), jnp.bfloat16)
-    use_flash = is_available(q.transpose(0, 2, 1, 3))
-
-    if use_flash:
-        core = lambda q, k, v: flash_attention_bhsd(q, k, v, causal=causal)
-    else:
-        def core(q, k, v):
-            s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
-                           preferred_element_type=jnp.float32) / (Dh ** 0.5)
-            if causal:
-                m = jnp.tril(jnp.ones((S, S), bool))
-                s = jnp.where(m[None, None], s, -1e30)
-            p = jax.nn.softmax(s, axis=-1)
-            return jnp.einsum("bhqk,bhkd->bhqd", p.astype(q.dtype), v)
-
-    def fwdbwd(q, k, v):
-        def loss(q, k, v):
-            return jnp.sum(core(q, k, v).astype(jnp.float32))
-        l, gs = jax.value_and_grad(loss, argnums=(0, 1, 2))(q, k, v)
-        return l + sum(jnp.sum(g.astype(jnp.float32)) for g in gs)
-
-    t = _time(fwdbwd, q, k, v, reps=reps)
-    # fwd 2 dots + bwd 5 dots ~= 3.5x fwd matmul flops; causal halves
-    flops = 3.5 * 2.0 * 2.0 * B * H * S * S * Dh * (0.5 if causal else 1.0)
-    return t, flops, ("flash" if use_flash else "xla")
+        float(jax.device_get(loss(x0 + jnp.asarray(i, x0.dtype), *args[1:])))
+        best = min(best, time.perf_counter() - t0)
+    per_exec = best / (chain * iters)
+    return per_exec, 3.0 * flops_per_exec / per_exec / 1e12
 
 
 def peak_tflops():
-    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
-    table = {"v4": 275.0, "v5e": 197.0, "v5p": 459.0, "v6e": 918.0}
-    for kk, vv in table.items():
-        if gen.startswith(kk):
-            return vv
-    return 197.0 if jax.devices()[0].platform == "tpu" else 0.5
+    from scripts.bert_sparse_bench import peak_tflops as _pt
+    return _pt()
 
 
 def decompose(name):
-    """Per-component timing at the given bench geometry."""
+    """Composite-unit timing at the given bench geometry: the per-layer
+    matmul chain (qkv/attn-out/ffn, with gelu), the attention core, and
+    the vocab head, each fwd+bwd."""
     if name == "1.3b":
         D, Hh, L, S, micro, V = 2048, 16, 24, 2048, 2, 50304
-        causal, ffn_mult, head_rows = True, 4, micro * S
-        gas = 8
+        causal, head_rows = True, micro * S
+        step_ref = "bench.py (BENCH_r0N.json detail.step_time_s / gas=8)"
     elif name == "bert128":
         D, Hh, L, S, micro, V = 1024, 16, 24, 128, 64, 30528
-        causal, ffn_mult = False, 4
-        head_rows = 2048  # mlm_gather_frac=0.25 of 8192
-        gas = 1
+        causal = False
+        head_rows = 64 * 128  # bench_bert runs the FULL head (gather off)
+        step_ref = "BENCH_EXTRA.json bert_large_zero2 seq128 step_time_s"
     elif name == "bert512":
         D, Hh, L, S, micro, V = 1024, 16, 24, 512, 16, 30528
-        causal, ffn_mult = False, 4
-        head_rows = 2048
-        gas = 1
+        causal = False
+        head_rows = 16 * 512
+        step_ref = "BENCH_EXTRA.json bert_large_zero2 seq512 step_time_s"
     else:
         raise ValueError(name)
     M = micro * S
     Dh = D // Hh
-    mm_shapes = {
-        "qkv": (M, D, 3 * D),
-        "attn_out": (M, D, D),
-        "ffn_in": (M, D, ffn_mult * D),
-        "ffn_out": (M, ffn_mult * D, D),
-    }
-    rows = {}
-    per_layer_fwdbwd = 0.0
-    per_layer_flops = 0.0
-    for k, (m, kk, n) in mm_shapes.items():
-        f, fb, fl = _matmul_pair(m, kk, n)
-        rows[k] = {"shape": [m, kk, n], "fwd_ms": round(f * 1e3, 3),
-                   "fwdbwd_ms": round(fb * 1e3, 3),
-                   "fwdbwd_tflops": round(3 * fl / fb / 1e12, 1)}
-        per_layer_fwdbwd += fb
-        per_layer_flops += 3 * fl
-    t_attn, fl_attn, attn_impl = _attn_core(micro, Hh, S, Dh, causal)
-    rows["attention_core"] = {
-        "impl": attn_impl, "geometry": [micro, Hh, S, Dh],
-        "fwdbwd_ms": round(t_attn * 1e3, 3),
-        "fwdbwd_tflops": round(fl_attn / t_attn / 1e12, 1),
-    }
-    f, fb, fl = _matmul_pair(head_rows, D, V, reps=4)
-    rows["logits_head"] = {"shape": [head_rows, D, V],
-                           "fwd_ms": round(f * 1e3, 3),
-                           "fwdbwd_ms": round(fb * 1e3, 3),
-                           "fwdbwd_tflops": round(3 * fl / fb / 1e12, 1)}
+    key = jax.random.PRNGKey(0)
 
-    floor = (per_layer_fwdbwd + t_attn) * L + fb
-    floor_flops = (per_layer_flops + fl_attn) * L + 3 * fl
+    # --- per-layer matmul chain (qkv -> attn_out -> ffn_in/gelu -> out) ---
+    x = jax.random.normal(key, (M, D), jnp.bfloat16)
+    w_qkv = jax.random.normal(key, (D, 3 * D), jnp.bfloat16) * 0.02
+    w_ao = jax.random.normal(key, (D, D), jnp.bfloat16) * 0.02
+    w_fi = jax.random.normal(key, (D, 4 * D), jnp.bfloat16) * 0.02
+    w_fo = jax.random.normal(key, (4 * D, D), jnp.bfloat16) * 0.02
+
+    def layer_mm(x, w_qkv, w_ao, w_fi, w_fo):
+        qkv = x @ w_qkv
+        ctx = qkv[:, :D]  # attention core timed separately
+        a = ctx @ w_ao
+        hgelu = jax.nn.gelu((x + a) @ w_fi, approximate=False)
+        return jnp.sum((hgelu @ w_fo).astype(jnp.float32))
+
+    mm_flops = 2.0 * M * D * D * (3 + 1 + 4 + 4)
+    t_mm, tf_mm = _time_unit(layer_mm, (x, w_qkv, w_ao, w_fi, w_fo),
+                             mm_flops, chain=2, iters=6)
+
+    # --- attention core at model geometry ---
+    from deeperspeed_tpu.ops.pallas.flash_attention import (
+        flash_attention_bhsd, is_available)
+
+    qh = jax.random.normal(key, (micro, Hh, S, Dh), jnp.bfloat16)
+    # mirror the models' attn_impl='auto' policy exactly (incl. the
+    # short-sequence XLA preference) so the floor times what the bench runs
+    use_flash = S > 256 and is_available(qh.transpose(0, 2, 1, 3))
+
+    def attn_loss(qh):
+        if use_flash:
+            o = flash_attention_bhsd(qh, qh, qh, causal=causal)
+        else:
+            s = jnp.einsum("bhqd,bhkd->bhqk", qh, qh,
+                           preferred_element_type=jnp.float32) / (Dh ** 0.5)
+            if causal:
+                mask = jnp.tril(jnp.ones((S, S), bool))
+                s = jnp.where(mask[None, None], s, -1e30)
+            pr = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum("bhqk,bhkd->bhqd", pr.astype(qh.dtype), qh)
+        return jnp.sum(o.astype(jnp.float32))
+
+    attn_flops = 2.0 * 2.0 * micro * Hh * S * S * Dh * (
+        0.5 if causal else 1.0)
+    t_attn, tf_attn = _time_unit(attn_loss, (qh,), attn_flops, chain=4,
+                                 iters=4)
+
+    # --- vocab head ---
+    xh = jax.random.normal(key, (head_rows, D), jnp.bfloat16)
+    w_v = jax.random.normal(key, (D, V), jnp.bfloat16) * 0.02
+
+    def head_loss(xh, w_v):
+        return jnp.sum((xh @ w_v).astype(jnp.float32))
+
+    head_flops = 2.0 * head_rows * D * V
+    t_head, tf_head = _time_unit(head_loss, (xh, w_v), head_flops, chain=2,
+                                 iters=4)
+
+    floor = L * (t_mm + t_attn) + t_head
+    floor_flops = 3.0 * (L * (mm_flops + attn_flops) + head_flops)
     return {
         "model": name,
-        "per_op": rows,
-        "micro_floor_s": round(floor, 4),
-        "micro_floor_tflops": round(floor_flops / floor / 1e12, 1),
-        "gas": gas,
-        "note": ("floor = L*(matmul chain + attention) + head, each timed "
-                 "standalone fwd+bwd; a full micro-step slower than this is "
-                 "paying for elementwise/remat/optimizer/dispatch; ops whose "
-                 "fwdbwd_tflops sit far under the MATMUL_CEILING.json number "
-                 "for their shape class are the per-op deficit"),
+        "units_fwdbwd": {
+            "layer_matmul_chain": {"ms": round(t_mm * 1e3, 3),
+                                   "tflops": round(tf_mm, 1),
+                                   "flops_fwd": mm_flops},
+            "attention_core": {"impl": "flash" if use_flash else "xla",
+                               "geometry": [micro, Hh, S, Dh],
+                               "ms": round(t_attn * 1e3, 3),
+                               "tflops": round(tf_attn, 1),
+                               "flops_fwd": attn_flops},
+            "vocab_head": {"rows": head_rows, "ms": round(t_head * 1e3, 3),
+                           "tflops": round(tf_head, 1),
+                           "flops_fwd": head_flops},
+        },
+        "micro_step_floor_ms": round(floor * 1e3, 1),
+        "micro_step_floor_tflops": round(floor_flops / floor / 1e12, 1),
+        "compare_step_time_against": step_ref,
+        "note": ("floor = L*(matmul chain + attention) + head, each a "
+                 "composite unit timed fwd+bwd with chained dependent "
+                 "executions (the tunnel's ~5ms fixed per-scan-iteration "
+                 "cost dilutes below 5%); a full engine micro-step slower "
+                 "than this floor is paying for elementwise/layernorm/"
+                 "remat/optimizer/dispatch, a unit whose tflops sit far "
+                 "below MATMUL_CEILING.json for its shape class is "
+                 "shape- or VPU-bound, not framework-bound"),
     }
 
 

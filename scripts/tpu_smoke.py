@@ -30,6 +30,7 @@ def _check(name, fn):
         ))
         assert np.isfinite(tot), f"non-finite output {tot}"
         print(f"  {name:44s} OK  (checksum {tot:.4g})", flush=True)
+        return out
     except Exception:  # noqa: BLE001 — summary line, then the full evidence
         print(f"  {name:44s} FAIL — full traceback follows", flush=True)
         raise
@@ -76,15 +77,31 @@ def main():
         cfg = FixedSparsityConfig(num_heads=H, block=128, num_local_blocks=4,
                                   num_global_blocks=1,
                                   attention="unidirectional")
-        fn = make_block_sparse_attention(np.asarray(cfg.make_layout(S)), 128,
-                                         causal=True)
+        layout = np.asarray(cfg.make_layout(S))
         q = jax.random.normal(jax.random.PRNGKey(2), (1, S, H, 64),
                               jnp.bfloat16)
-        _check(f"sparse fixed S={S} fwd",
-               jax.jit(lambda q=q, fn=fn: fn(q, q, q)))
-        _check(f"sparse fixed S={S} fwd+bwd",
-               jax.jit(lambda q=q, fn=fn: jax.grad(
-                   lambda q: (fn(q, q, q).astype(jnp.float32) ** 2).sum())(q)))
+        outs = {}
+        # both kernel families on hardware: 'resident' (flash-style,
+        # whole-seq K/V in VMEM — only where the VMEM budget admits it)
+        # and 'stream' (LUT-driven BlockSpec streaming, the long-S
+        # fallback) — and their outputs must agree
+        from deeperspeed_tpu.ops.sparse_attention.kernels import resident_ok
+        impls = (("resident", "stream") if resident_ok(S, 64)
+                 else ("stream",))
+        for impl in impls:
+            fn = make_block_sparse_attention(layout, 128, causal=True,
+                                             impl=impl)
+            outs[impl] = _check(f"sparse fixed S={S} {impl} fwd",
+                                jax.jit(lambda q=q, fn=fn: fn(q, q, q)))
+            _check(f"sparse fixed S={S} {impl} fwd+bwd",
+                   jax.jit(lambda q=q, fn=fn: jax.grad(
+                       lambda q: (fn(q, q, q).astype(jnp.float32) ** 2)
+                       .sum())(q)))
+        if "resident" in outs:
+            d = np.max(np.abs(np.asarray(outs["resident"], np.float32)
+                              - np.asarray(outs["stream"], np.float32)))
+            assert d < 2e-2, f"resident/stream divergence {d} at S={S}"
+            print(f"  resident/stream parity S={S}: max|d|={d:.2e}")
 
     cfg = BigBirdSparsityConfig(num_heads=4, block=128, num_random_blocks=1,
                                 num_sliding_window_blocks=3,

@@ -200,3 +200,35 @@ def test_remat_policy_validation():
         _small_cfg(remat_policy="bogus")
     with pytest.raises(ValueError, match="mlm_gather_frac"):
         _small_cfg(mlm_gather_frac=1.5)
+
+
+def test_mlm_gather_frac_real_cut_and_drop():
+    """Exercise an ACTUAL prefix cut (K < B*S) and the documented drop
+    behavior when scored positions exceed the cut."""
+    B, S = 2, 128  # BS=256; frac 0.25 -> K=128 < 256
+    rs = np.random.RandomState(3)
+    ids = jnp.asarray(rs.randint(0, 128, (B, S)))
+    # few scored positions (fits under K): exact parity with the full head
+    labels = np.full((B, S), -100)
+    pos = rs.choice(B * S, size=40, replace=False)
+    labels.reshape(-1)[pos] = np.asarray(ids).reshape(-1)[pos]
+    labels = jnp.asarray(labels)
+    d = dict(vocab_size=128, n_layer=2, n_head=2, d_model=32, max_seq=S,
+             remat=False, dtype=jnp.float32, attn_impl="xla", ce_chunk=0)
+    init_fn, _, loss_full, _ = make_bert(BertConfig(**d))
+    _, _, loss_g, _ = make_bert(BertConfig(**d, mlm_gather_frac=0.25))
+    params = init_fn(jax.random.PRNGKey(0))
+    np.testing.assert_allclose(float(loss_full(params, (ids, labels))),
+                               float(loss_g(params, (ids, labels))),
+                               rtol=1e-6)
+    # overflow: 200 scored positions > K=128 -> exactly K scored rows
+    # survive (stable order), the loss normalizer counts only those
+    labels_over = np.full((B * S,), -100)
+    over_pos = np.sort(rs.choice(B * S, size=200, replace=False))
+    flat_ids = np.asarray(ids).reshape(-1)
+    labels_over[over_pos] = flat_ids[over_pos]
+    labels_kept = np.full((B * S,), -100)
+    labels_kept[over_pos[:128]] = flat_ids[over_pos[:128]]
+    l_over = float(loss_g(params, (ids, jnp.asarray(labels_over.reshape(B, S)))))
+    l_kept = float(loss_full(params, (ids, jnp.asarray(labels_kept.reshape(B, S)))))
+    np.testing.assert_allclose(l_over, l_kept, rtol=1e-6)

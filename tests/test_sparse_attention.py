@@ -128,8 +128,9 @@ def _dense_ref(q, k, v, layout, causal):
     return block_sparse_attention_xla(q, k, v, layout, BLOCK, causal=causal)
 
 
+@pytest.mark.parametrize("impl", ["stream", "resident"])
 @pytest.mark.parametrize("causal", [False, True])
-def test_kernel_matches_dense_mask_fixed(causal):
+def test_kernel_matches_dense_mask_fixed(causal, impl):
     cfg = FixedSparsityConfig(
         num_heads=H, block=BLOCK, num_local_blocks=2, num_global_blocks=1,
         attention="unidirectional" if causal else "bidirectional",
@@ -137,42 +138,48 @@ def test_kernel_matches_dense_mask_fixed(causal):
     layout = cfg.make_layout(64)
     q, k, v = _qkv(jax.random.PRNGKey(0))
     attend = make_block_sparse_attention(layout, BLOCK, causal=causal,
-                                         interpret=True)
+                                         interpret=True, impl=impl)
     out = jax.jit(attend)(q, k, v)
     ref = _dense_ref(q, k, v, layout, causal)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5,
                                atol=2e-5)
 
 
-def test_kernel_matches_dense_mask_bigbird():
+@pytest.mark.parametrize("impl", ["stream", "resident"])
+def test_kernel_matches_dense_mask_bigbird(impl):
     cfg = BigBirdSparsityConfig(num_heads=H, block=BLOCK, num_random_blocks=1,
                                 num_sliding_window_blocks=3, num_global_blocks=1)
     layout = cfg.make_layout(64)
     q, k, v = _qkv(jax.random.PRNGKey(1))
-    attend = make_block_sparse_attention(layout, BLOCK, interpret=True)
+    attend = make_block_sparse_attention(layout, BLOCK, interpret=True,
+                                         impl=impl)
     out = jax.jit(attend)(q, k, v)
     ref = _dense_ref(q, k, v, layout, False)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5,
                                atol=2e-5)
 
 
-def test_kernel_empty_rows_zero_output():
+@pytest.mark.parametrize("impl", ["stream", "resident"])
+def test_kernel_empty_rows_zero_output(impl):
     """A head whose layout row has no blocks must emit zeros, not NaNs."""
     layout = np.zeros((1, 4, 4), np.int64)
     layout[0, 0, 0] = 1  # only the first block row attends anywhere
     q, k, v = _qkv(jax.random.PRNGKey(2), B=1, S=32, heads=1)
-    attend = make_block_sparse_attention(layout, BLOCK, interpret=True)
+    attend = make_block_sparse_attention(layout, BLOCK, interpret=True,
+                                         impl=impl)
     out = np.asarray(jax.jit(attend)(q, k, v))
     assert np.isfinite(out).all()
     assert np.abs(out[:, 8:]).max() == 0.0  # rows beyond block 0: no keys
 
 
-def test_kernel_grads_match_dense_mask():
+@pytest.mark.parametrize("impl", ["stream", "resident"])
+def test_kernel_grads_match_dense_mask(impl):
     cfg = BSLongformerSparsityConfig(num_heads=H, block=BLOCK,
                                      num_sliding_window_blocks=3)
     layout = cfg.make_layout(32)
     q, k, v = _qkv(jax.random.PRNGKey(3), S=32)
-    attend = make_block_sparse_attention(layout, BLOCK, interpret=True)
+    attend = make_block_sparse_attention(layout, BLOCK, interpret=True,
+                                         impl=impl)
 
     g_sparse = jax.jit(jax.grad(lambda q, k, v: jnp.sum(attend(q, k, v) ** 2),
                                 argnums=(0, 1, 2)))(q, k, v)
@@ -249,3 +256,50 @@ def test_bert_sparse_self_attention():
     out = mod.apply(params, hidden)
     assert out.shape == hidden.shape
     assert np.isfinite(np.asarray(out)).all()
+
+
+@pytest.mark.parametrize("impl", ["stream", "resident"])
+def test_kernel_grads_match_dense_mask_causal(impl):
+    """Causal grads: exercises the dkdv kernels' diagonal-block masking
+    (for the resident path, the transposed chunk LUT's full/masked
+    classification — a full-width chunk containing the diagonal q-row
+    block must still be masked)."""
+    cfg = FixedSparsityConfig(
+        num_heads=H, block=BLOCK, num_local_blocks=3, num_global_blocks=1,
+        attention="unidirectional",
+    )
+    layout = cfg.make_layout(64)
+    q, k, v = _qkv(jax.random.PRNGKey(4))
+    attend = make_block_sparse_attention(layout, BLOCK, causal=True,
+                                         interpret=True, impl=impl)
+    g_sparse = jax.jit(jax.grad(lambda q, k, v: jnp.sum(attend(q, k, v) ** 2),
+                                argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.grad(
+        lambda q, k, v: jnp.sum(_dense_ref(q, k, v, layout, True) ** 2),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b in zip(g_sparse, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=5e-4,
+                                   atol=5e-4)
+
+
+def test_kernel_two_word_bitmap_super_tiles(monkeypatch):
+    """SROW x CHUNK > 31 packs the entry bitmap into (lo, hi) int32 words;
+    parity vs the dense reference must hold (causal grads included)."""
+    from deeperspeed_tpu.ops.sparse_attention import kernels as kmod
+    monkeypatch.setattr(kmod, "SROW", 8)
+    cfg = BSLongformerSparsityConfig(num_heads=H, block=BLOCK,
+                                     num_sliding_window_blocks=3)
+    layout = cfg.make_layout(64)
+    q, k, v = _qkv(jax.random.PRNGKey(5))
+    attend = make_block_sparse_attention(layout, BLOCK, causal=True,
+                                         interpret=True, impl="resident")
+    g_sparse = jax.jit(jax.grad(lambda q, k, v: jnp.sum(attend(q, k, v) ** 2),
+                                argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.grad(
+        lambda q, k, v: jnp.sum(_dense_ref(q, k, v, layout, True) ** 2),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b in zip(g_sparse, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=5e-4,
+                                   atol=5e-4)
