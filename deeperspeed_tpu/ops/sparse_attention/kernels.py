@@ -1079,14 +1079,19 @@ def make_block_sparse_attention(layout: np.ndarray, block: int,
             )
         return _luts["stream"]
 
+    def _causal_layout():
+        # THE single causal-filter site: the resident LUTs (both
+        # orientations) and the auto cost model all derive from this one
+        # filtered layout, so masking and kernel selection can never
+        # desynchronize
+        lay_c = layout != 0
+        if causal:
+            lay_c = lay_c & np.tril(np.ones((nb, nb), bool))[None]
+        return lay_c
+
     def _resident_luts():
         if "resident" not in _luts:
-            # single causal-filter site: fwd/dq and (transposed) dkdv LUTs
-            # both derive from this one filtered layout, so their masking
-            # can never desynchronize
-            lay_c = layout != 0
-            if causal:
-                lay_c = lay_c & np.tril(np.ones((nb, nb), bool))[None]
+            lay_c = _causal_layout()
             _luts["resident"] = (
                 build_super_lut(lay_c, chunk, srow, causal),
                 build_super_lut(lay_c.transpose(0, 2, 1), chunk, srow,
@@ -1104,10 +1109,7 @@ def make_block_sparse_attention(layout: np.ndarray, block: int,
         if not resident_ok(S, Dh, jnp.dtype(dtype).itemsize):
             return False
         if _waste[0] is None:
-            lay_c = layout != 0
-            if causal:
-                lay_c = lay_c & np.tril(np.ones((nb, nb), bool))[None]
-            _waste[0] = supertile_waste(lay_c, chunk, srow)
+            _waste[0] = supertile_waste(_causal_layout(), chunk, srow)
         return _waste[0] <= 2.0
 
     @jax.custom_vjp

@@ -70,10 +70,18 @@ MIN_QUANT_SIZE = 1 << 20
 
 
 def _fetch(x):
-    """Device wire -> host numpy (single buffer or per-leaf tuple)."""
+    """Device wire -> host numpy (single buffer or per-leaf tuple).
+
+    OWNED copies, never views: on the CPU backend np.asarray of a jax
+    array can alias the device buffer zero-copy, and these wire buffers
+    come from donating jits — the allocator recycles them for later calls
+    while the host optimizer is still reading. Reproduced as a
+    device/shadow parity flake under host CPU contention (1-in-3 with a
+    6.7B init saturating the core); the copy is small against the host
+    Adam pass that consumes it."""
     if isinstance(x, (tuple, list)):
-        return [np.asarray(p) for p in x]
-    return np.asarray(x)
+        return [np.array(p, copy=True) for p in x]
+    return np.array(x, copy=True)
 
 
 def _wire(x):
@@ -437,7 +445,15 @@ class StreamedOffloadEngine:
         return templates, chunks
 
     def _chunk_to_tree_bf16(self, cname: str):
-        """Host shadow bits -> bf16 numpy pytree matching device layout."""
+        """Host shadow bits -> bf16 numpy pytree matching device layout.
+
+        OWNED copies, never views of the shadow: on the CPU backend
+        jax.device_put zero-copy ALIASES numpy buffers, so view-backed
+        uploads made the device params share memory with the shadow that
+        the host optimizer mutates in place (and the first donated apply
+        may write back into) — a device/shadow parity corruption that
+        surfaced as a load-dependent test flake. TPU uploads always copy
+        to HBM, which is why hardware runs never showed it."""
         import ml_dtypes
         bf = np.dtype(ml_dtypes.bfloat16)
         leaves, treedef = jax.tree.flatten(self._leaf_templates[cname])
@@ -445,7 +461,8 @@ class StreamedOffloadEngine:
         out, off = [], 0
         for t in leaves:
             n = int(np.prod(t.shape))
-            out.append(bits[off: off + n].reshape(t.shape).view(bf))
+            out.append(np.array(bits[off: off + n], copy=True)
+                       .reshape(t.shape).view(bf))
             off += n
         return jax.tree.unflatten(treedef, out)
 

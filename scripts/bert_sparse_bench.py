@@ -121,7 +121,7 @@ def bench_bert(seq: int, micro: int, steps: int, warmup: int,
 
 
 def bench_sparse_vs_dense(S: int, steps: int, sparsity_cfg=None,
-                          skip_naive=False, impl="auto"):
+                          skip_naive=False):
     """fwd+bwd attention core: block-sparse Pallas vs dense flash, BERT-
     large head geometry (16 heads x 64 dh)."""
     from deeperspeed_tpu.ops.pallas.flash_attention import (
@@ -137,8 +137,7 @@ def bench_sparse_vs_dense(S: int, steps: int, sparsity_cfg=None,
     if sparsity_cfg is None:
         sparsity_cfg = FixedSparsityConfig(num_heads=H, block=128,
                                            attention="unidirectional")
-    sparse = SparseSelfAttention(sparsity_cfg, max_seq_length=S, causal=True,
-                                 impl=impl)
+    sparse = SparseSelfAttention(sparsity_cfg, max_seq_length=S, causal=True)
     layout = sparse.get_layout(S)
     density = float(layout.sum()) / layout.size
 

@@ -35,12 +35,23 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 
-def _time_unit(unit_loss, args, flops_per_exec, chain=4, iters=6):
+def _unit_chain(flops_per_exec, target_ms=60.0, assume_tflops=50.0):
+    """Executions per scan iteration sized so per-iteration work is
+    ~target_ms even for tiny units (the attention core at seq 128 is a
+    4 GFLOP op): the tunnel's ~5ms fixed per-iteration cost then stays
+    under ~10% of every unit's reading."""
+    est_ms = 3.0 * flops_per_exec / (assume_tflops * 1e12) * 1e3
+    return int(min(64, max(2, round(target_ms / max(est_ms, 1e-3)))))
+
+
+def _time_unit(unit_loss, args, flops_per_exec, chain=None, iters=4):
     """fwd+bwd time per execution of `unit_loss(*args) -> scalar`:
     each scan iteration runs `chain` dependent executions (x perturbed by
     the previous gradient, so nothing hoists), sized so per-iteration work
     dwarfs the axon tunnel's ~5ms fixed per-iteration cost; flops are
     counted as 3x forward (dgrad + wgrad)."""
+    if chain is None:
+        chain = _unit_chain(flops_per_exec)
     x0 = args[0]
 
     def one(x, *rest):
@@ -118,7 +129,7 @@ def decompose(name):
 
     mm_flops = 2.0 * M * D * D * (3 + 1 + 4 + 4)
     t_mm, tf_mm = _time_unit(layer_mm, (x, w_qkv, w_ao, w_fi, w_fo),
-                             mm_flops, chain=2, iters=6)
+                             mm_flops)
 
     # --- attention core at model geometry ---
     from deeperspeed_tpu.ops.pallas.flash_attention import (
@@ -144,8 +155,7 @@ def decompose(name):
 
     attn_flops = 2.0 * 2.0 * micro * Hh * S * S * Dh * (
         0.5 if causal else 1.0)
-    t_attn, tf_attn = _time_unit(attn_loss, (qh,), attn_flops, chain=4,
-                                 iters=4)
+    t_attn, tf_attn = _time_unit(attn_loss, (qh,), attn_flops)
 
     # --- vocab head ---
     xh = jax.random.normal(key, (head_rows, D), jnp.bfloat16)
@@ -155,8 +165,7 @@ def decompose(name):
         return jnp.sum((xh @ w_v).astype(jnp.float32))
 
     head_flops = 2.0 * head_rows * D * V
-    t_head, tf_head = _time_unit(head_loss, (xh, w_v), head_flops, chain=2,
-                                 iters=4)
+    t_head, tf_head = _time_unit(head_loss, (xh, w_v), head_flops)
 
     floor = L * (t_mm + t_attn) + t_head
     floor_flops = 3.0 * (L * (mm_flops + attn_flops) + head_flops)
