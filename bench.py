@@ -66,8 +66,15 @@ def main():
 
     on_tpu = jax.devices()[0].platform == "tpu"
     model = os.environ.get("DS_BENCH_MODEL", "1.3b" if on_tpu else "smoke")
+    # remat A/B knob: DS_BENCH_REMAT=off runs full-save (no remat) — the
+    # MFU_DECOMP floor shows the matmul units at ~95% of peak, so the
+    # residual step-time is elementwise/replay work that full-save removes
+    # (at the price of ~2GB more live activations at mb2)
+    remat_env = os.environ.get("DS_BENCH_REMAT", "matmuls")
     if model == "1.3b":
-        cfg = get_preset("neox-1.3b", remat=True, remat_policy="matmuls",
+        cfg = get_preset("neox-1.3b", remat=remat_env != "off",
+                         remat_policy="matmuls" if remat_env == "off"
+                         else remat_env,
                          ce_chunk=128, max_seq=1024)
         # 'matmuls' selective remat saves flash o/lse + q/k/v + pre-gelu so
         # the backward replays only elementwise ops; mb2 keeps the saved
