@@ -121,13 +121,19 @@ def _time_unit(unit_loss, args, flops_per_exec, chain=None,
         # artifact (the failure mode the rewrite exists to prevent).
         # NOTE peak comes from PALLAS_AXON_TPU_GEN with a v5e default, so
         # on a faster unrecognized chip a legitimate reading can exceed
-        # it — after 3 failed attempts the reading is returned but marked
-        # suspect rather than aborting the whole decomposition.
+        # it — after 3 failed attempts an above-peak (but positive-delta)
+        # reading is returned marked suspect rather than aborting. A
+        # jitter-INVERTED pair (t_hi <= t_lo) is never returnable: its
+        # per_exec is negative and would poison the floor silently.
         if t_hi > t_lo and (tf <= 1.1 * peak or not on_tpu):
             return per_exec, tf, False
         print(f"[mfu_decomp] implausible unit timing (t_lo={t_lo:.3f}s "
               f"t_hi={t_hi:.3f}s -> {tf:.0f} TF vs peak {peak:.0f}); "
               f"remeasuring ({attempt + 1}/3)", flush=True)
+    if t_hi <= t_lo:
+        raise RuntimeError(
+            "unit timing inverted (t_hi <= t_lo) 3x — tunnel too unstable "
+            "to decompose; rerun in a quieter window")
     return per_exec, tf, True
 
 
@@ -162,6 +168,9 @@ def decompose(name):
     M = micro * S
     Dh = D // Hh
     key = jax.random.PRNGKey(0)
+    # mirror _time_unit's platform-dependent windows so the note describes
+    # the measurement that actually ran
+    lo_it, hi_it = (16, 64) if jax.devices()[0].platform == "tpu" else (2, 6)
 
     # --- per-layer matmul chain (qkv -> attn_out -> ffn_in/gelu -> out) ---
     x = jax.random.normal(key, (M, D), jnp.bfloat16)
@@ -249,9 +258,10 @@ def decompose(name):
         "micro_step_floor_tflops": round(floor_flops / floor / 1e12, 1),
         "compare_step_time_against": step_ref,
         "platform": jax.devices()[0].platform,
+        "device": str(jax.devices()[0].device_kind),
         "note": ("floor = L*(matmul chain + attention) + head, each a "
                  "composite unit timed fwd+bwd as the DIFFERENCE between "
-                 "a 64-iteration and a 16-iteration scan of chained "
+                 f"a {hi_it}- and a {lo_it}-iteration scan of chained "
                  "dependent executions (cancels the tunnel's per-call "
                  "dispatch overhead and its jitter; unit losses are "
                  "sum-of-squares so XLA cannot algebraically collapse "
@@ -269,6 +279,7 @@ def main():
     ap.add_argument("--out", default=os.path.join(REPO, "MFU_DECOMP.json"))
     args = ap.parse_args()
     plat = jax.devices()[0].platform
+    dev = str(jax.devices()[0].device_kind)
     out = {}
     if os.path.exists(args.out):  # merge: keep models not re-run this time
         try:
@@ -280,13 +291,16 @@ def main():
         # must not produce a mixed-provenance artifact (e.g. a CPU smoke
         # run inheriting TPU timings under a "platform": "cpu" header).
         # Legacy entries without their own stamp inherit the loaded
-        # file's top-level platform, NOT the current one.
+        # file's top-level values, NOT the current ones. Device kind is
+        # filtered too: v4-measured timings must not survive under a
+        # rewritten v5e header/peak.
         file_plat = out.get("platform", plat)
+        file_dev = out.get("device", dev)
         out = {k: v for k, v in out.items()
                if not (isinstance(v, dict)
-                       and v.get("platform", file_plat) != plat)}
-    out.update({"platform": plat,
-                "device": str(jax.devices()[0].device_kind),
+                       and (v.get("platform", file_plat) != plat
+                            or v.get("device", file_dev) != dev))}
+    out.update({"platform": plat, "device": dev,
                 "peak_tflops": peak_tflops()})
     for m in args.models.split(","):
         out[m] = decompose(m.strip())
