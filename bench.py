@@ -68,8 +68,9 @@ def main():
     model = os.environ.get("DS_BENCH_MODEL", "1.3b" if on_tpu else "smoke")
     # remat A/B knob: DS_BENCH_REMAT=off runs full-save (no remat) — the
     # MFU_DECOMP floor shows the matmul units at ~95% of peak, so the
-    # residual step-time is elementwise/replay work that full-save removes
-    # (at the price of ~2GB more live activations at mb2)
+    # residual step-time is elementwise/replay work that full-save removes.
+    # MEASURED (r3): off at mb2 needs 19.95GB vs 15.75GB HBM — full-save
+    # does not fit the 1.3B run; 'matmuls' selective remat stays default
     remat_env = os.environ.get("DS_BENCH_REMAT", "matmuls")
     if model == "1.3b":
         cfg = get_preset("neox-1.3b", remat=remat_env != "off",
