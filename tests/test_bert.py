@@ -195,6 +195,25 @@ def test_remat_policy_matmuls_matches_full():
         np.testing.assert_allclose(np.asarray(x), np.asarray(y), atol=1e-5)
 
 
+def test_remat_policy_dots_all_matches_full():
+    """dots_saveable (no matmul replay in backward) is a scheduling
+    choice too: loss and grads must match full remat."""
+    rs = np.random.RandomState(4)
+    ids = jnp.asarray(rs.randint(0, 128, (2, 32)))
+    labels = jnp.asarray(
+        np.where(rs.rand(2, 32) < 0.15, np.asarray(ids), -100))
+    cfg_a = _small_cfg(remat=True)
+    cfg_b = _small_cfg(remat=True, remat_policy="dots_all")
+    init_fn, _, loss_a, _ = make_bert(cfg_a)
+    _, _, loss_b, _ = make_bert(cfg_b)
+    params = init_fn(jax.random.PRNGKey(3))
+    la, ga = jax.value_and_grad(lambda p: loss_a(p, (ids, labels)))(params)
+    lb, gb = jax.value_and_grad(lambda p: loss_b(p, (ids, labels)))(params)
+    np.testing.assert_allclose(float(la), float(lb), rtol=1e-6)
+    for x, y in zip(jax.tree.leaves(ga), jax.tree.leaves(gb)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), atol=1e-5)
+
+
 def test_remat_policy_validation():
     with pytest.raises(ValueError, match="remat_policy"):
         _small_cfg(remat_policy="bogus")
