@@ -72,11 +72,22 @@ def main():
     # MEASURED (r3): off at mb2 needs 19.95GB vs 15.75GB HBM — full-save
     # does not fit the 1.3B run; 'matmuls' selective remat stays default
     remat_env = os.environ.get("DS_BENCH_REMAT", "matmuls")
+    # ce knob applies only to the 1.3b config below; reject it elsewhere
+    # rather than silently ignoring it
+    ce_env = int(os.environ.get("DS_BENCH_CE", "-1"))
+    if ce_env >= 0 and model != "1.3b":
+        raise SystemExit("DS_BENCH_CE only applies to DS_BENCH_MODEL=1.3b")
     if model == "1.3b":
+        # ce_chunk=0 (fused logits+lse, no streaming): at mb2 the full
+        # (2,1024,50304) fp32 logits are only 412MB, and the r4 ablation
+        # measured chunked ce128 costing 61ms/step (7.7ms/micro) vs the
+        # fused path — the 256-row chunk matmuls run far below the vocab
+        # head's 190 TF and the @checkpoint replay adds a 4th head matmul
         cfg = get_preset("neox-1.3b", remat=remat_env != "off",
                          remat_policy="matmuls" if remat_env == "off"
                          else remat_env,
-                         ce_chunk=128, max_seq=1024)
+                         ce_chunk=ce_env if ce_env >= 0 else 0,
+                         max_seq=1024)
         # 'matmuls' selective remat saves flash o/lse + q/k/v + pre-gelu so
         # the backward replays only elementwise ops; mb2 keeps the saved
         # activations at ~0.8GB while gas=8 restores the batch (measured:
@@ -106,6 +117,7 @@ def main():
     # not reliably released between engines on the tunneled platform)
     micro = int(os.environ.get("DS_BENCH_MICRO", micro))
     gas = int(os.environ.get("DS_BENCH_GAS", gas))
+    steps = int(os.environ.get("DS_BENCH_STEPS", steps))
 
     init_fn, _, loss_fn, _ = make_gpt(cfg)
     params = init_fn(jax.random.PRNGKey(0))

@@ -192,6 +192,8 @@ def _dev_quant(x_flat, bits: int, block: int, key):
         return x_flat.astype(jnp.bfloat16), jnp.zeros((0,), jnp.float32)
     nb = -(-n // block)
     qm = _qmax(bits)
+    if nb == 0:  # empty leaf: empty wire + empty scales
+        return jnp.zeros((0,), jnp.uint8), jnp.zeros((0,), jnp.float32)
     seg = min(nb, 8192)  # 8192 blocks * 128 * 4B = 4MB fp32 per temporary
     nseg = -(-nb // seg)
     padded = jnp.pad(x_flat, (0, nseg * seg * block - n))  # input dtype

@@ -65,8 +65,9 @@ def skeletal_engine(cfg, scfg):
     eng._leaf_templates["globals"] = gl
     eng._meta["globals"] = _ChunkMeta(gl, scfg.wire_bits)
     eng.chunk_names.append("globals")
-    eng.n_params = sum(m.total for m in eng._meta.values()) - (
-        eng._meta["g0"].total * (eng.n_groups - 1))  # unique: g0 + globals
+    # every group owns distinct layers: the real count is all groups +
+    # globals (ADVICE r3: a g0+globals shortcut undercounted ~n_groups x)
+    eng.n_params = sum(m.total for m in eng._meta.values())
     eng._fns = {}
     eng._build_fns()
     return eng, lay, gl

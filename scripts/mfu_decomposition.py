@@ -33,6 +33,13 @@ import numpy as np  # noqa: E402
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+# Bump when the measurement methodology changes (e.g. the r3 move from
+# absolute timing + linear losses to differenced windows + sum-of-squares
+# losses). Each model entry is stamped with it, and the artifact merge
+# drops kept entries whose stamp differs — retracted-methodology numbers
+# must not survive a partial --models rerun under the new header.
+METHODOLOGY = "differenced-windows-sq-loss-v2"
+
 
 
 def _unit_chain(flops_per_exec, target_ms=60.0, assume_tflops=200.0):
@@ -259,6 +266,7 @@ def decompose(name):
         "compare_step_time_against": step_ref,
         "platform": jax.devices()[0].platform,
         "device": str(jax.devices()[0].device_kind),
+        "methodology": METHODOLOGY,
         "note": ("floor = L*(matmul chain + attention) + head, each a "
                  "composite unit timed fwd+bwd as the DIFFERENCE between "
                  f"a {hi_it}- and a {lo_it}-iteration scan of chained "
@@ -296,10 +304,15 @@ def main():
         # rewritten v5e header/peak.
         file_plat = out.get("platform", plat)
         file_dev = out.get("device", dev)
-        out = {k: v for k, v in out.items()
-               if not (isinstance(v, dict)
-                       and (v.get("platform", file_plat) != plat
-                            or v.get("device", file_dev) != dev))}
+        dropped = [k for k, v in out.items()
+                   if isinstance(v, dict)
+                   and (v.get("platform", file_plat) != plat
+                        or v.get("device", file_dev) != dev
+                        or v.get("methodology") != METHODOLOGY)]
+        if dropped:
+            print(f"dropping kept entries (platform/device/methodology "
+                  f"mismatch vs current run): {dropped}", flush=True)
+        out = {k: v for k, v in out.items() if k not in dropped}
     out.update({"platform": plat, "device": dev,
                 "peak_tflops": peak_tflops()})
     for m in args.models.split(","):

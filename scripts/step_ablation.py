@@ -1,0 +1,117 @@
+"""In-situ step-time ablation for the 1.3B flagship (VERDICT r3 item 1).
+
+MFU_DECOMP.json gives the composite-unit floor; this script attributes the
+remaining in-engine residual by timing the ACTUAL model functions (not
+isolated units) under controlled variants:
+
+  fwd        — jit(loss_fn) per micro
+  fwdbwd     — jit(value_and_grad(loss_fn)) per micro
+  variants   — attention impl (flash vs xla), remat policy, ce_chunk
+
+The fwd/bwd split shows whether the gap is forward elementwise (paid once)
+or backward replay (paid under remat). Usage:
+  python scripts/step_ablation.py [--micro 2] [--seq 1024] [--steps 20]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _sync(out):
+    """jax.block_until_ready returns immediately on the tunneled axon
+    platform (buffers report ready at allocation); a scalar device_get is
+    the only reliable barrier (same pattern as bench.py). Executions are
+    in-order per device, so fetching one leaf of the LAST output waits for
+    the whole queue."""
+    jax.device_get(jax.tree.leaves(out)[0])
+
+
+def time_fn(fn, args, steps, warmup=3):
+    for _ in range(warmup):
+        out = fn(*args)
+    _sync(out)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn(*args)
+    _sync(out)
+    return (time.perf_counter() - t0) / steps
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--micro", type=int, default=2)
+    ap.add_argument("--seq", type=int, default=1024)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--preset", default="neox-1.3b")
+    ap.add_argument(
+        "--variants",
+        default="base,xla_attn,ce128,dots_all",
+        help="comma list: base, xla_attn, ce128, ce0, dots_all, flash_policy",
+    )
+    args = ap.parse_args()
+
+    from deeperspeed_tpu.models.gpt import get_preset, make_gpt
+
+    KNOWN = ("base", "xla_attn", "ce128", "ce0", "dots_all", "flash_policy")
+
+    def cfg_for(variant):
+        if variant not in KNOWN:
+            raise SystemExit(f"unknown variant {variant!r}; choose from {KNOWN}")
+        kw = dict(remat=True, remat_policy="matmuls", ce_chunk=0,
+                  max_seq=args.seq)
+        if variant == "xla_attn":
+            kw["attn_impl"] = "xla"
+        elif variant == "ce128":
+            kw["ce_chunk"] = 128
+        elif variant == "dots_all":
+            kw["remat_policy"] = "dots_all"
+        elif variant == "flash_policy":
+            kw["remat_policy"] = "flash"
+        return get_preset(args.preset, **kw)
+
+    rng = np.random.default_rng(0)
+    batch = jnp.asarray(
+        rng.integers(0, 50304, size=(args.micro, args.seq + 1), dtype=np.int32)
+    )
+    out = {"preset": args.preset, "micro": args.micro, "seq": args.seq,
+           "platform": jax.devices()[0].platform,
+           "device": str(jax.devices()[0].device_kind), "variants": {}}
+
+    base_params = None
+    for variant in args.variants.split(","):
+        variant = variant.strip()
+        cfg = cfg_for(variant)
+        init_fn, _, loss_fn, _ = make_gpt(cfg)
+        if base_params is None:
+            base_params = jax.tree.map(
+                lambda p: p.astype(jnp.bfloat16), init_fn(jax.random.PRNGKey(0))
+            )
+        params = base_params
+
+        fwd = jax.jit(loss_fn)
+        t_fwd = time_fn(fwd, (params, batch), args.steps)
+
+        grad = jax.jit(jax.value_and_grad(loss_fn))
+        t_fb = time_fn(grad, (params, batch), args.steps)
+
+        out["variants"][variant] = {
+            "fwd_ms": round(t_fwd * 1e3, 2),
+            "fwdbwd_ms": round(t_fb * 1e3, 2),
+            "bwd_over_fwd": round((t_fb - t_fwd) / t_fwd, 2),
+        }
+        print(variant, json.dumps(out["variants"][variant]), flush=True)
+
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
