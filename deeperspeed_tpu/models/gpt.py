@@ -268,6 +268,19 @@ def layer_norm(x, scale, bias, eps):
     return (y * scale + bias).astype(x.dtype)
 
 
+def layer_norm2(x, scale1, bias1, scale2, bias2, eps):
+    """Two layernorms of the SAME input (the NeoX parallel-residual block
+    applies ln1 and ln2 both to x): mean/var are computed once and only
+    the affine differs — halves the fp32 reduction passes over x in both
+    the forward and the backward."""
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mu), axis=-1, keepdims=True)
+    y = (x32 - mu) * jax.lax.rsqrt(var + eps)
+    return ((y * scale1 + bias1).astype(x.dtype),
+            (y * scale2 + bias2).astype(x.dtype))
+
+
 def rotary_embedding(x, positions, rotary_dims):
     """Apply rotary position embedding to the first rotary_dims of head_dim.
 
@@ -373,9 +386,19 @@ def decoder_block(cfg: GPTConfig, mesh, x, layer_params, positions, attend,
     cdt = cfg.dtype
     B, S, D = x.shape
     H, Dh = cfg.n_head, cfg.head_dim
-    attn_in = layer_norm(
-        x, layer_params["ln1_scale"], layer_params["ln1_bias"], cfg.layernorm_eps
-    )
+    mlp_in_shared = None
+    if cfg.parallel_residual:
+        # ln1(x) and ln2(x) normalize the SAME x — share the mean/var pass
+        attn_in, mlp_in_shared = layer_norm2(
+            x, layer_params["ln1_scale"], layer_params["ln1_bias"],
+            layer_params["ln2_scale"], layer_params["ln2_bias"],
+            cfg.layernorm_eps,
+        )
+    else:
+        attn_in = layer_norm(
+            x, layer_params["ln1_scale"], layer_params["ln1_bias"],
+            cfg.layernorm_eps,
+        )
     qkv = attn_in @ layer_params["attn"]["wqkv"].astype(cdt) + layer_params[
         "attn"
     ]["bqkv"].astype(cdt)
@@ -399,10 +422,9 @@ def decoder_block(cfg: GPTConfig, mesh, x, layer_params, positions, attend,
     ]["bo"].astype(cdt)
 
     if cfg.parallel_residual:
-        # NeoX: x + attn(ln1(x)) + mlp(ln2(x))
-        mlp_in = layer_norm(
-            x, layer_params["ln2_scale"], layer_params["ln2_bias"], cfg.layernorm_eps
-        )
+        # NeoX: x + attn(ln1(x)) + mlp(ln2(x)); mlp_in computed above in
+        # the shared-normalization pass
+        mlp_in = mlp_in_shared
     else:
         x = x + attn_out
         mlp_in = layer_norm(

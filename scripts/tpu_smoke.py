@@ -66,6 +66,32 @@ def main():
                lambda q: (flash_attention(q, q, q, causal=False)
                           .astype(jnp.float32) ** 2).sum())(q)))
 
+    # v1 streaming kernel explicitly (the dispatch above routes short S to
+    # the static kernel; v1 still serves S > MAX_STATIC_SEQ and explicit
+    # block sizes — keep its Mosaic lowering exercised)
+    q = jax.random.normal(jax.random.PRNGKey(2), (2, 1024, 4, 64), jnp.bfloat16)
+    _check("flash v1 (explicit blocks) fwd+bwd",
+           jax.jit(lambda q=q: jax.grad(
+               lambda q: (flash_attention(q, q, q, causal=True, block_q=256,
+                                          block_k=256)
+                          .astype(jnp.float32) ** 2).sum())(q)))
+    q = jax.random.normal(jax.random.PRNGKey(3), (1, 4096, 2, 64), jnp.bfloat16)
+    _check("flash v1 long-S (auto past static gate) fwd+bwd",
+           jax.jit(lambda q=q: jax.grad(
+               lambda q: (flash_attention(q, q, q, causal=True)
+                          .astype(jnp.float32) ** 2).sum())(q)))
+
+    # static kernel at its unroll ceiling
+    from deeperspeed_tpu.ops.pallas.flash_static import (
+        flash_attention_static_bhsd)
+
+    q = jax.random.normal(jax.random.PRNGKey(4), (1, 2, 2048, 128),
+                          jnp.bfloat16)
+    _check("flash v2 static S=2048 fwd+bwd",
+           jax.jit(lambda q=q: jax.grad(
+               lambda q: (flash_attention_static_bhsd(q, q, q, causal=True)
+                          .astype(jnp.float32) ** 2).sum())(q)))
+
     # ---- block-sparse attention --------------------------------------- #
     from deeperspeed_tpu.ops.sparse_attention.kernels import (
         make_block_sparse_attention)
