@@ -163,3 +163,112 @@ def make_onebit_spmd_train_step(loss_fn, optimizer, mesh,
         return new_p, OnebitCommState(m=m, v=v, werr=werr, serr=serr), loss
 
     return init_comm_state, step
+
+
+class OnebitLambCommState(NamedTuple):
+    """1-bit LAMB wire state: OnebitCommState plus the per-leaf lamb
+    scaling coefficients (live during warmup, FROZEN in the compressed
+    phase — reference lamb.py:137 'frozen lamb coefficients')."""
+    m: jnp.ndarray        # (n,) replicated
+    v: jnp.ndarray        # (n,) replicated (frozen after warmup)
+    werr: jnp.ndarray     # (W, n) sharded over data
+    serr: jnp.ndarray     # (W, c) sharded over data
+    ratios: jnp.ndarray   # (n_leaves,) replicated lamb coefficients
+
+
+def make_onebit_lamb_spmd_train_step(loss_fn, optimizer, mesh,
+                                     phase: str, data_axis: str = DATA_AXIS):
+    """1-bit LAMB wire path (the 20B north-star names 1-bit LAMB,
+    BASELINE.md row 5; reference runtime/fp16/onebit/lamb.py:11).
+
+    Same two-phase momentum wire as make_onebit_spmd_train_step; the LAMB
+    difference is the per-leaf trust ratio ||w|| / ||update||, which is
+    LIVE during warmup and read from comm.ratios in the compressed phase
+    (the reference's frozen scaling coefficients — recomputing the ratio
+    from 1-bit momentum would feed quantization noise into the layer-wise
+    learning rates). The host captures comm.ratios when flipping phases.
+
+    step(params, comm, batch, lr, step_idx) -> (params, comm, loss).
+    No bias correction, matching the in-state OnebitLamb (onebit.py:174).
+    """
+    if phase not in ("warmup", "compressed"):
+        raise ValueError(f"phase must be 'warmup'|'compressed', got {phase}")
+    b1, b2 = optimizer.betas
+    eps, wd = optimizer.eps, optimizer.weight_decay
+    min_c = getattr(optimizer, "min_coeff", 0.01)
+    max_c = getattr(optimizer, "max_coeff", 10.0)
+    W = mesh.shape[data_axis]
+
+    adam_init, _ = make_onebit_spmd_train_step(loss_fn, optimizer, mesh,
+                                               phase=phase,
+                                               data_axis=data_axis)
+
+    def init_comm_state(params) -> OnebitLambCommState:
+        base = adam_init(params)  # same m/v/werr/serr layout and sharding
+        return OnebitLambCommState(
+            m=base.m, v=base.v, werr=base.werr, serr=base.serr,
+            ratios=jnp.ones((len(jax.tree.leaves(params)),), jnp.float32),
+        )
+
+    def body(params, m, v, ratios, werr, serr, batch, lr):
+        werr, serr = werr[0], serr[0]
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        loss = jax.lax.pmean(loss, data_axis)
+        g, unravel = ravel_pytree(grads)
+        if phase == "warmup":
+            g = jax.lax.pmean(g.astype(jnp.float32), data_axis)
+            m_new = b1 * m + (1.0 - b1) * g
+            v_new = b2 * v + (1.0 - b2) * g * g
+        else:
+            m_local = b1 * m + (1.0 - b1) * g.astype(jnp.float32)
+            m_new, werr, serr = onebit_all_reduce_2phase(
+                m_local, data_axis, werr, serr, W)
+            v_new = v  # frozen
+        upd_flat = m_new / (jnp.sqrt(v_new) + eps)
+        upd_tree = unravel(upd_flat)
+
+        flat_p, treedef = jax.tree.flatten(params)
+        flat_u = treedef.flatten_up_to(upd_tree)
+        new_flat_p, live_ratios = [], []
+        for i, (p, u) in enumerate(zip(flat_p, flat_u)):
+            p32 = p.astype(jnp.float32)
+            if wd:
+                u = u + wd * p32
+            w_norm = jnp.sqrt(jnp.sum(p32 * p32))
+            u_norm = jnp.sqrt(jnp.sum(u * u))
+            live = jnp.where(
+                (w_norm > 0) & (u_norm > 0),
+                jnp.clip(w_norm / u_norm, min_c, max_c),
+                1.0,
+            )
+            ratio = live if phase == "warmup" else ratios[i]
+            live_ratios.append(live)
+            new_flat_p.append((p32 - lr * ratio * u).astype(p.dtype))
+        new_params = treedef.unflatten(new_flat_p)
+        # warmup tracks live ratios (the values frozen at the phase flip);
+        # compressed keeps the frozen ones unchanged
+        new_ratios = (jnp.stack(live_ratios) if phase == "warmup"
+                      else ratios)
+        return (new_params, m_new, v_new, new_ratios, werr[None], serr[None],
+                loss)
+
+    rep = P()
+    sh = P(data_axis, None)
+    mapped = shard_map(
+        body, mesh=mesh,
+        in_specs=(rep, rep, rep, rep, sh, sh, P(data_axis), rep),
+        out_specs=(rep, rep, rep, rep, sh, sh, rep),
+        **_SHMAP_CHECK_KWARGS,
+    )
+
+    @jax.jit
+    def step(params, comm: OnebitLambCommState, batch, lr, step_idx=None):
+        """step_idx accepted for API symmetry with the Adam wire (LAMB has
+        no bias correction, so it is unused)."""
+        new_p, m, v, ratios, werr, serr, loss = mapped(
+            params, comm.m, comm.v, comm.ratios, comm.werr, comm.serr,
+            batch, jnp.float32(lr))
+        return new_p, OnebitLambCommState(
+            m=m, v=v, werr=werr, serr=serr, ratios=ratios), loss
+
+    return init_comm_state, step

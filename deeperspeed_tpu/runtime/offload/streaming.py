@@ -857,6 +857,137 @@ class StreamedOffloadEngine:
         return float(loss)
 
     # ------------------------------------------------------------- #
+    # checkpoint / resume (VERDICT r3 item 4: the 6.7B runs died at the
+    # tunnel's ~2h kill with no way to continue; reference parity:
+    # stage3.py:3238 save prologue + swapped-state checkpointing)
+    # ------------------------------------------------------------- #
+
+    def _geometry(self) -> dict:
+        """Fingerprint that must match for a resume to be valid."""
+        return {
+            "n_params": int(self.n_params),
+            "chunk_names": list(self.chunk_names),
+            "chunk_sizes": {c: self._meta[c].sizes
+                            for c in self.chunk_names},
+            "wire_bits": self.scfg.wire_bits,
+            "group_layers": self.scfg.group_layers,
+        }
+
+    def save_checkpoint(self, save_dir: str, tag: Optional[str] = None):
+        """Write per-chunk host state (bf16 shadow + fp32 master/moments)
+        plus step/rng under ``save_dir/<tag>/``, then point ``latest`` at
+        it. One chunk is materialized at a time (an NVMe-tier 20B model's
+        states never coexist in RAM); writes go to a tmp dir renamed into
+        place so a killed save never corrupts ``latest``."""
+        import json as _json
+        import shutil
+
+        tag = tag or f"global_step{self.step_count}"
+        final = os.path.join(save_dir, tag)
+        tmp = final + f".tmp{os.getpid()}"
+        os.makedirs(tmp, exist_ok=True)
+
+        def dump(cname, states):
+            np.save(os.path.join(tmp, f"{cname}.shadow.npy"),
+                    self._shadow[cname])
+            for k in ("master", "exp_avg", "exp_avg_sq"):
+                np.save(os.path.join(tmp, f"{cname}.{k}.npy"), states[k])
+
+        if self.swapper is None:
+            for c in self.chunk_names:
+                dump(c, self._ram[c])
+        else:
+            # read-only iteration: for_each_leaf would swap every chunk's
+            # unchanged state back OUT after the dump, doubling save I/O
+            for c in self.chunk_names:
+                buf = self.swapper.swap_in(c, async_op=False)
+                dump(c, self.swapper.unpack(c, buf))
+                del buf
+        meta = {
+            "step_count": self.step_count,
+            "rng_state": self._rng.bit_generator.state,
+            "geometry": self._geometry(),
+        }
+        with open(os.path.join(tmp, "stream_meta.json"), "w") as f:
+            _json.dump(meta, f)
+        prev_latest = None
+        latest_path = os.path.join(save_dir, "latest")
+        if os.path.isfile(latest_path):
+            with open(latest_path) as f:
+                prev_latest = f.read().strip()
+        old = None
+        if os.path.isdir(final):
+            # never rmtree the live tag before the new one is in place: a
+            # kill between the two would leave 'latest' pointing at nothing
+            old = final + f".old{os.getpid()}"
+            os.replace(final, old)
+        os.replace(tmp, final)
+        # atomic 'latest' update (tmp file + rename)
+        with open(latest_path + ".tmp", "w") as f:
+            f.write(tag)
+        os.replace(latest_path + ".tmp", latest_path)
+        if old is not None:
+            shutil.rmtree(old, ignore_errors=True)
+        # prune the previous checkpoint: at 6.7B each save is ~90GB and the
+        # NVMe tier shares the disk — unbounded retention would ENOSPC the
+        # run the feature exists to protect
+        if prev_latest and prev_latest != tag:
+            stale = os.path.join(save_dir, prev_latest)
+            if os.path.isdir(stale):
+                shutil.rmtree(stale, ignore_errors=True)
+        log_dist(f"StreamedOffloadEngine: saved checkpoint {final}",
+                 ranks=[0])
+        return final
+
+    def load_checkpoint(self, save_dir: str, tag: Optional[str] = None):
+        """Restore host state saved by save_checkpoint and re-upload the
+        device params from the restored shadow. Geometry must match the
+        engine's construction (same model/grouping/wire)."""
+        import json as _json
+
+        if tag is None:
+            latest = os.path.join(save_dir, "latest")
+            if not os.path.isfile(latest):
+                log_dist(f"no 'latest' in {save_dir}; starting fresh",
+                         ranks=[0])
+                return None
+            with open(latest) as f:
+                tag = f.read().strip()
+        ckpt = os.path.join(save_dir, tag)
+        with open(os.path.join(ckpt, "stream_meta.json")) as f:
+            meta = _json.load(f)
+        mine = self._geometry()
+        theirs = meta["geometry"]
+        if theirs != mine:
+            raise ValueError(
+                f"checkpoint geometry mismatch: saved {theirs}, engine "
+                f"built with {mine}")
+
+        def load_states(cname):
+            return {k: np.load(os.path.join(ckpt, f"{cname}.{k}.npy"))
+                    for k in ("master", "exp_avg", "exp_avg_sq")}
+
+        for c in self.chunk_names:
+            self._shadow[c] = np.load(
+                os.path.join(ckpt, f"{c}.shadow.npy"))
+            states = load_states(c)
+            if self.swapper is None:
+                self._ram[c] = states
+            else:
+                self.swapper.register_leaf(c, states)
+            del states
+        self.step_count = int(meta["step_count"])
+        self._rng.bit_generator.state = meta["rng_state"]
+        # device params re-uploaded from the restored shadow
+        self._dev_groups = []
+        self._dev_globals = None
+        self._upload_initial()
+        log_dist(
+            f"StreamedOffloadEngine: resumed {ckpt} at step "
+            f"{self.step_count}", ranks=[0])
+        return ckpt
+
+    # ------------------------------------------------------------- #
 
     def wire_bytes_per_step(self) -> int:
         """Bytes on the host<->device wire per step (both directions,

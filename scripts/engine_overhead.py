@@ -1,0 +1,110 @@
+"""Engine step decomposition on hardware (VERDICT r4 follow-through).
+
+Times, with the REAL engine object at the flagship config:
+  grads8   — jit of engine._batch_grads alone (the gas-scan, 8 micros)
+  update   — jit of engine._apply_update_body alone (postprocess + Adam +
+             overflow select + state rebuild)
+  full     — engine._train_batch_fn (the fused step bench.py runs)
+
+full - grads8 - update = fusion/donation overhead of composing the two.
+Each timed async over N reps with a device_get barrier.
+
+Usage: python scripts/engine_overhead.py [--steps 10]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def timed(fn, args, steps, warmup=2):
+    out = None
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.device_get(jax.tree.leaves(out)[0].ravel()[0])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn(*args)
+    jax.device_get(jax.tree.leaves(out)[0].ravel()[0])
+    return (time.perf_counter() - t0) / steps
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=10)
+    # one part per process: holding grads8's outputs alive next to the full
+    # step's donated state OOMs the 16GB chip
+    ap.add_argument("--part", default="full",
+                    choices=["grads8", "update", "full"])
+    args = ap.parse_args()
+
+    import deeperspeed_tpu as ds
+    from deeperspeed_tpu.models.gpt import get_preset, make_gpt
+
+    cfg = get_preset("neox-1.3b", remat=True, remat_policy="matmuls",
+                     ce_chunk=0, max_seq=1024)
+    micro, gas, seq = 2, 8, 1024
+    init_fn, _, loss_fn, _ = make_gpt(cfg)
+    params = init_fn(jax.random.PRNGKey(0))
+    engine, _, _, _ = ds.initialize(
+        model=loss_fn, model_parameters=params,
+        config={
+            "train_micro_batch_size_per_gpu": micro,
+            "gradient_accumulation_steps": gas,
+            "optimizer": {"type": "Adam",
+                          "params": {"lr": 1e-4, "betas": [0.9, 0.95]}},
+            "bf16": {"enabled": True, "master_weights": False},
+            "zero_optimization": {"stage": 0},
+            "gradient_clipping": 1.0,
+            "steps_per_print": 10**9,
+        },
+    )
+    del params
+    rng = np.random.default_rng(0)
+    batch = jnp.asarray(rng.integers(
+        0, cfg.vocab_size, size=(micro * gas, seq + 1), dtype=np.int32))
+    key = jax.random.PRNGKey(0)
+    lr = np.float32(1e-4)
+    gasf = np.float32(gas)
+
+    state = engine.state
+    out = {"part": args.part, "platform": jax.devices()[0].platform}
+
+    if args.part == "grads8":
+        grads8 = jax.jit(
+            lambda st, b, r: engine._batch_grads(st, b, r, gas))
+        t_g = timed(grads8, (state, batch, key), args.steps)
+        out["grads8_ms"] = round(t_g * 1e3, 1)
+        out["grads8_per_micro_ms"] = round(t_g / gas * 1e3, 2)
+    elif args.part == "update":
+        grads8 = jax.jit(
+            lambda st, b, r: engine._batch_grads(st, b, r, gas))
+        loss, grads = grads8(state, batch, key)
+        update = jax.jit(engine._apply_update_body)
+        t_u = timed(update, (state, grads, lr, gasf), args.steps)
+        out["update_ms"] = round(t_u * 1e3, 1)
+    else:
+        full = engine._train_batch_fn()
+        # re-feed the returned (donated) state, as the engine does
+        st, m = full(state, batch, lr, key)
+        engine.state = None  # drop the original reference: donation live
+        jax.device_get(m["loss"])
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            st, m = full(st, batch, lr, key)
+        jax.device_get(m["loss"])
+        t_f = (time.perf_counter() - t0) / args.steps
+        out["full_ms"] = round(t_f * 1e3, 1)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

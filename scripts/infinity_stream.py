@@ -53,6 +53,16 @@ def main():
     # smoke and makes the trajectory monotone when optimization is healthy
     ap.add_argument("--fixed-batch", action="store_true")
     ap.add_argument("--out", default=None)
+    # checkpoint/resume: the tunnel kills clients ~2h in with no error (both
+    # r3 6.7B runs died at step 7) — periodic saves + --resume let evidence
+    # accumulate across sessions instead of being capped by the
+    # infrastructure (VERDICT r3 item 4)
+    ap.add_argument("--ckpt-dir", default=None,
+                    help="checkpoint directory (enables saving)")
+    ap.add_argument("--save-every", type=int, default=2,
+                    help="save every N steps when --ckpt-dir is set")
+    ap.add_argument("--resume", action="store_true",
+                    help="resume from --ckpt-dir's latest before training")
     args = ap.parse_args()
 
     import jax
@@ -91,11 +101,25 @@ def main():
     probs /= probs.sum()
     B, S = args.micro_batch, args.seq
 
-    losses, step_times, breakdowns = [], [], []
-    prev = {k: v for k, v in eng.timings.items()}
+    # the fixed batch is drawn BEFORE any resume so it is identical across
+    # sessions (same seed, same draw order)
     fixed = (r.choice(V, size=(B, S + 1), p=probs).astype(np.int32)
              if args.fixed_batch else None)
-    for step in range(1, args.steps + 1):
+    start_step = 0
+    if args.resume and args.ckpt_dir:
+        if eng.load_checkpoint(args.ckpt_dir) is not None:
+            start_step = eng.step_count
+            if fixed is None:
+                # replay the per-step batch draws consumed before the save
+                # so resumed fresh-batch steps see the session-1 sequence
+                for _ in range(start_step):
+                    r.choice(V, size=(B, S + 1), p=probs)
+            print(f"[infinity_stream] resumed at step {start_step}",
+                  flush=True)
+
+    losses, step_times, breakdowns = [], [], []
+    prev = {k: v for k, v in eng.timings.items()}
+    for step in range(start_step + 1, start_step + args.steps + 1):
         tokens = (fixed if fixed is not None
                   else r.choice(V, size=(B, S + 1), p=probs).astype(np.int32))
         t0 = time.perf_counter()
@@ -108,8 +132,13 @@ def main():
         losses.append(round(loss, 4))
         step_times.append(round(dt, 2))
         breakdowns.append(delta)
-        print(f"[infinity_stream] step {step}/{args.steps} loss={loss:.4f} "
-              f"{dt:.1f}s {delta}", flush=True)
+        print(f"[infinity_stream] step {step}/{start_step + args.steps} "
+              f"loss={loss:.4f} {dt:.1f}s {delta}", flush=True)
+        if args.ckpt_dir and step % max(args.save_every, 1) == 0:
+            t0 = time.perf_counter()
+            eng.save_checkpoint(args.ckpt_dir)
+            print(f"[infinity_stream] checkpoint @step {step} "
+                  f"({time.perf_counter() - t0:.1f}s)", flush=True)
 
     wire = eng.wire_bytes_per_step()
     steady = step_times[1:] or step_times
@@ -123,6 +152,7 @@ def main():
         "wire_bits": args.wire_bits,
         "state_device": args.state,
         "steps": args.steps,
+        "start_step": start_step,
         "fixed_batch": bool(args.fixed_batch),
         "losses": losses,
         "loss_first": losses[0], "loss_last": losses[-1],

@@ -61,7 +61,8 @@ def main():
 
     from deeperspeed_tpu.models.gpt import get_preset, make_gpt
 
-    KNOWN = ("base", "xla_attn", "ce128", "ce0", "dots_all", "flash_policy")
+    KNOWN = ("base", "xla_attn", "ce128", "ce0", "dots_all", "flash_policy",
+             "no_rotary", "no_remat")
 
     def cfg_for(variant):
         if variant not in KNOWN:
@@ -76,6 +77,12 @@ def main():
             kw["remat_policy"] = "dots_all"
         elif variant == "flash_policy":
             kw["remat_policy"] = "flash"
+        elif variant == "no_rotary":
+            # attribution only (different model: learned positions instead
+            # of rotary trig on q/k) — the delta bounds rotary's step cost
+            kw["rotary"] = False
+        elif variant == "no_remat":
+            kw["remat"] = False
         return get_preset(args.preset, **kw)
 
     rng = np.random.default_rng(0)

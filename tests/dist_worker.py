@@ -183,7 +183,8 @@ def main():
         return jnp.mean((outputs - labels) ** 2)
 
     step = make_spmd_pipeline_train_step(
-        stage_fn, mse, opt, num_stages=S_, micro_batches=M_, mesh=pipe_mesh)
+        stage_fn, mse, opt, num_stages=S_, micro_batches=M_,
+        mesh=pipe_mesh, schedule="1f1b")
     xs = jax.random.normal(jax.random.PRNGKey(6), (M_, 4, D_), jnp.float32)
     ys = jax.random.normal(jax.random.PRNGKey(7), (M_, 4, D_), jnp.float32)
     with pipe_mesh:

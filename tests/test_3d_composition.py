@@ -84,7 +84,7 @@ def _run(mesh, param_specs, steps=5):
     fn = _stage_fn if param_specs is not None else _stage_fn_dense
     step = make_spmd_pipeline_train_step(
         fn, _loss_fn, opt, num_stages=PP, micro_batches=M, mesh=mesh,
-        remat=False, param_specs=param_specs,
+        remat=False, param_specs=param_specs, schedule="1f1b",
     )
     x, y = _data(np.random.default_rng(0))
     losses = []
@@ -115,7 +115,7 @@ def test_3d_param_shards_update_consistently():
     opt_state = opt.init(params)
     step = make_spmd_pipeline_train_step(
         _stage_fn, _loss_fn, opt, num_stages=PP, micro_batches=M, mesh=mesh,
-        remat=False, param_specs=PARAM_SPECS,
+        remat=False, param_specs=PARAM_SPECS, schedule="1f1b",
     )
     x, y = _data(np.random.default_rng(0))
     with mesh:
@@ -132,7 +132,7 @@ def test_param_specs_must_lead_with_pipe():
     with pytest.raises(AssertionError, match="pipe"):
         make_spmd_pipeline_train_step(
             _stage_fn, _loss_fn, opt, num_stages=PP, micro_batches=M,
-            mesh=mesh, param_specs=bad,
+            mesh=mesh, param_specs=bad, schedule="1f1b",
         )(_init_params(jax.random.PRNGKey(0)),
           opt.init(_init_params(jax.random.PRNGKey(0))),
           *_data(np.random.default_rng(0)), 1e-2)

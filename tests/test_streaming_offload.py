@@ -353,3 +353,73 @@ def test_fresh_init_streams_chunks_and_trains():
     losses = [eng.train_batch(t) for t in batch(n=10)]
     assert np.isfinite(losses).all()
     assert np.mean(losses[-3:]) < losses[0], losses
+
+
+def test_checkpoint_resume_bitwise(tmp_path, monkeypatch):
+    """VERDICT r3 item 4: save mid-run, rebuild a FRESH engine, resume —
+    the continued trajectory must be bit-identical to the uninterrupted
+    one (shadow/master/moments/step/rng all restored)."""
+    monkeypatch.setattr(streaming, "MIN_QUANT_SIZE", 0)
+    cfg = tiny_cfg()
+    scfg = StreamConfig(micro_batch=B, seq=S, wire_bits=4, warmup_steps=0)
+    data = batch(seed=3, n=6)
+
+    eng, params = make_engine(cfg, scfg)
+    for i in range(2):
+        eng.train_batch(data[i])
+    eng.save_checkpoint(str(tmp_path), tag="t")
+    cont = [eng.train_batch(data[i]) for i in range(2, 6)]
+
+    eng2, _ = make_engine(cfg, scfg)  # fresh weights — all overwritten
+    eng2.load_checkpoint(str(tmp_path), tag="t")
+    assert eng2.step_count == 2
+    resumed = [eng2.train_batch(data[i]) for i in range(2, 6)]
+    np.testing.assert_array_equal(np.asarray(cont), np.asarray(resumed))
+    # device params identical too
+    a = eng.device_params_tree()
+    b = eng2.device_params_tree()
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_checkpoint_latest_and_geometry_guard(tmp_path):
+    cfg = tiny_cfg()
+    scfg = StreamConfig(micro_batch=B, seq=S, wire_bits=8, warmup_steps=0)
+    eng, _ = make_engine(cfg, scfg)
+    eng.train_batch(batch(seed=1)[0])
+    eng.save_checkpoint(str(tmp_path))  # default tag = global_step1
+    assert (tmp_path / "latest").read_text() == "global_step1"
+    # geometry mismatch (different grouping) must refuse to load
+    cfg2 = tiny_cfg()
+    scfg2 = StreamConfig(micro_batch=B, seq=S, wire_bits=8,
+                         group_layers=2, warmup_steps=0)
+    eng2, _ = make_engine(cfg2, scfg2)
+    with pytest.raises(ValueError, match="geometry mismatch"):
+        eng2.load_checkpoint(str(tmp_path))
+    # empty dir: returns None, engine untouched
+    eng3, _ = make_engine(cfg, scfg)
+    assert eng3.load_checkpoint(str(tmp_path / "empty")) is None
+
+
+def test_checkpoint_resume_nvme_tier(tmp_path):
+    """Resume with the swapper state tier: states round-trip through the
+    NVMe files."""
+    cfg = tiny_cfg()
+    scfg = StreamConfig(micro_batch=B, seq=S, wire_bits=8, warmup_steps=0,
+                        state_device="nvme",
+                        swap_folder=str(tmp_path / "swap"),
+                        pipeline_swap=False)
+    data = batch(seed=5, n=4)
+    eng, _ = make_engine(cfg, scfg)
+    eng.train_batch(data[0])
+    eng.save_checkpoint(str(tmp_path / "ck"))
+    cont = [eng.train_batch(data[i]) for i in (1, 2)]
+
+    scfg2 = StreamConfig(micro_batch=B, seq=S, wire_bits=8, warmup_steps=0,
+                         state_device="nvme",
+                         swap_folder=str(tmp_path / "swap2"),
+                         pipeline_swap=False)
+    eng2, _ = make_engine(cfg, scfg2)
+    eng2.load_checkpoint(str(tmp_path / "ck"))
+    resumed = [eng2.train_batch(data[i]) for i in (1, 2)]
+    np.testing.assert_array_equal(np.asarray(cont), np.asarray(resumed))
