@@ -262,6 +262,25 @@ class StreamConfig:
     # fused native host codec (csrc ds_stream_chunk_step); False forces the
     # numpy path (tests / environments without g++)
     use_native_host: bool = True
+    # RESIDENT param precision on the chip: 16 = bf16 trees (the proven
+    # 6.7B profile); 4|8 = block-quantized codes + fp32 scales, dequantized
+    # to bf16 per layer-group transiently inside each jit. This is what
+    # lets 20B (41GB of bf16) hold a 16GB chip: int4 codes are ~10.3GB.
+    # Small leaves (< MIN_QUANT_SIZE: layernorms, biases) stay bf16
+    # resident regardless — their bytes are noise, their precision is not.
+    # The host shadow stores the same codes and replays the device's
+    # deterministic requantization bit-for-bit, so the error-feedback
+    # contract (shadow == device) is unchanged.
+    resident_bits: int = 16      # 16 | 8 | 4
+    # host optimizer state precision: 'fp32' (proven profile, 12 B/param)
+    # or 'bf16' (master+moments as bf16 bits, 6 B/param, fp32 transients
+    # per chunk — the host analog of the engine's masterless-bf16 mode;
+    # what fits 20B state in a 125GB-RAM + 80GB-disk container)
+    host_state: str = "fp32"     # fp32 | bf16
+    # which states ride the NVMe swapper when state_device='nvme':
+    # 'all' (default) or 'exp_avg_sq' (v only — the 20B budget keeps
+    # master+m in RAM and only v on disk)
+    swap_states: str = "all"
 
 
 class _ChunkMeta:
@@ -273,7 +292,7 @@ class _ChunkMeta:
     (precision close to bf16 with per-128 scales) so the concat stays
     uint8-uniform; bf16/fp32 modes keep per-leaf buffers (test paths)."""
 
-    def __init__(self, template, wire_bits: int):
+    def __init__(self, template, wire_bits: int, resident_bits: int = 16):
         leaves = jax.tree.leaves(
             template, is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct))
         self.sizes = [int(np.prod(t.shape)) for t in leaves]
@@ -283,6 +302,13 @@ class _ChunkMeta:
         self.bits = [
             wire_bits if (wire_bits >= 16 or s >= MIN_QUANT_SIZE) else 8
             for s in self.sizes]
+        # RESIDENT precision per leaf: quantized codes only for the large
+        # matmul weights; small leaves (layernorms/biases) stay bf16
+        self.res_bits = [
+            resident_bits if (resident_bits < 16 and s >= MIN_QUANT_SIZE)
+            else 16
+            for s in self.sizes]
+        self.quant_resident = any(b < 16 for b in self.res_bits)
 
     def wire_geometry(self, block: int):
         """Per-leaf packed-byte and scale counts + cumulative offsets for
@@ -293,6 +319,23 @@ class _ChunkMeta:
             padded = nb * block
             pb.append(padded // 2 if bits == 4 else padded)
             sc.append(nb)
+        return (pb, np.concatenate([[0], np.cumsum(pb)]).astype(np.int64),
+                sc, np.concatenate([[0], np.cumsum(sc)]).astype(np.int64))
+
+    def res_geometry(self, block: int):
+        """Uplink geometry for quant-resident chunks, whose h2d payload is
+        the new RESIDENT representation itself: int4/int8 codes for coded
+        leaves, raw bf16 bytes (2n, no scales) for the small ones."""
+        pb, sc = [], []
+        for n, bits in zip(self.sizes, self.res_bits):
+            if bits >= 16:
+                pb.append(2 * n)
+                sc.append(0)
+            else:
+                nb = -(-n // block)
+                padded = nb * block
+                pb.append(padded // 2 if bits == 4 else padded)
+                sc.append(nb)
         return (pb, np.concatenate([[0], np.cumsum(pb)]).astype(np.int64),
                 sc, np.concatenate([[0], np.cumsum(sc)]).astype(np.int64))
 
@@ -316,6 +359,12 @@ class StreamedOffloadEngine:
             raise ValueError(
                 f"wire_block must be positive and even (int4 half-split "
                 f"nibble packing), got {scfg.wire_block}")
+        if scfg.resident_bits not in (4, 8, 16):
+            raise ValueError("resident_bits must be 4, 8 or 16")
+        if scfg.host_state not in ("fp32", "bf16"):
+            raise ValueError("host_state must be 'fp32' or 'bf16'")
+        if scfg.swap_states not in ("all", "exp_avg_sq"):
+            raise ValueError("swap_states must be 'all' or 'exp_avg_sq'")
         if cfg.moe is not None:
             raise NotImplementedError(
                 "StreamedOffloadEngine supports dense GPT models")
@@ -354,20 +403,38 @@ class StreamedOffloadEngine:
             self._leaf_templates[cname] = template
             self.chunk_names.append(cname)
             self.n_params += flat.size
-            self._meta[cname] = _ChunkMeta(template, scfg.wire_bits)
-            self._shadow[cname] = f32_to_bf16_bits(flat)
+            meta = _ChunkMeta(template, scfg.wire_bits, scfg.resident_bits)
+            self._meta[cname] = meta
+            if meta.quant_resident:
+                # quantized residency: shadow = per-leaf codes; the master
+                # keeps the FULL init precision (the quantization residual
+                # re-injects through the error-fed delta wire over steps —
+                # at int4 the residual is ~10% of weight scale, too much to
+                # discard the way the bf16 profile's sub-bf16 bits were)
+                self._shadow[cname] = self._quant_shadow_from_f32(
+                    cname, meta, flat)
+                master = np.ascontiguousarray(flat, np.float32)
+            else:
+                self._shadow[cname] = f32_to_bf16_bits(flat)
+                # master tracks the SHADOW (what the device actually
+                # holds), so step 0 starts with zero residual
+                master = bf16_bits_to_f32(self._shadow[cname])
             del flat
-            # master tracks the SHADOW (what the device actually holds),
-            # so step 0 starts with zero residual
-            master = bf16_bits_to_f32(self._shadow[cname])
-            states = {"master": master,
-                      "exp_avg": np.zeros_like(master),
-                      "exp_avg_sq": np.zeros_like(master)}
+            states = {"master": self._st_store(master),
+                      "exp_avg": self._st_store(np.zeros_like(master)),
+                      "exp_avg_sq": self._st_store(np.zeros_like(master))}
+            del master
             if self.swapper is None:
                 self._ram[cname] = states
+            elif scfg.swap_states == "exp_avg_sq":
+                # 20B budget: master+m in RAM, v on the NVMe tier
+                self._ram[cname] = {k: states[k]
+                                    for k in ("master", "exp_avg")}
+                self.swapper.register_leaf(
+                    cname, {"exp_avg_sq": states["exp_avg_sq"]})
             else:
                 self.swapper.register_leaf(cname, states)
-            del states, master
+            del states
         log_dist(
             f"StreamedOffloadEngine: {self.n_params:,} params, "
             f"{self.n_groups} groups, wire=int{scfg.wire_bits}, "
@@ -379,6 +446,72 @@ class StreamedOffloadEngine:
         self._dev_globals = None
         self._upload_initial()
         self._fns: Dict[str, Any] = {}
+
+    # ------------------------------------------------------------- #
+    # shadow / host-state representation helpers
+    # ------------------------------------------------------------- #
+
+    def _st_store(self, f32: np.ndarray) -> np.ndarray:
+        """fp32 optimizer-state vector -> stored representation."""
+        if self.scfg.host_state == "bf16":
+            return f32_to_bf16_bits(f32)
+        return np.ascontiguousarray(f32, np.float32)
+
+    def _st_load(self, arr: np.ndarray) -> np.ndarray:
+        """Stored state -> fp32 working copy (in-place-safe transient)."""
+        if arr.dtype == np.uint16:
+            return bf16_bits_to_f32(arr)
+        return arr  # fp32 profile mutates in place (no copy)
+
+    def _st_writeback(self, store: np.ndarray, f32: np.ndarray):
+        if store.dtype == np.uint16:
+            store[:] = f32_to_bf16_bits(f32)
+        # fp32 profile: _st_load returned the same buffer; nothing to do
+
+    def _quant_shadow_from_f32(self, cname, meta: _ChunkMeta,
+                               flat: np.ndarray):
+        """Per-leaf shadow entries for a quant-resident chunk: (codes,
+        scales) for quantized leaves, bf16 bits for the small ones."""
+        block = self.scfg.wire_block
+        entries = []
+        for i in range(len(meta.sizes)):
+            o, n = int(meta.offsets[i]), meta.sizes[i]
+            leaf = flat[o: o + n]
+            if meta.res_bits[i] < 16:
+                entries.append(host_quant(leaf, meta.res_bits[i], block))
+            else:
+                entries.append(f32_to_bf16_bits(leaf))
+        return entries
+
+    def _shadow_f32(self, cname: str) -> np.ndarray:
+        """Shadow -> flat fp32 (bit-exact image of the device params)."""
+        meta = self._meta[cname]
+        sh = self._shadow[cname]
+        if not meta.quant_resident:
+            return bf16_bits_to_f32(sh)
+        out = np.empty(meta.total, np.float32)
+        block = self.scfg.wire_block
+        for i, entry in enumerate(sh):
+            o, n = int(meta.offsets[i]), meta.sizes[i]
+            if meta.res_bits[i] < 16:
+                codes, scales = entry
+                host_dequant(codes, scales, n, meta.res_bits[i], block,
+                             out=out[o: o + n])
+            else:
+                out[o: o + n] = bf16_bits_to_f32(entry)
+        return out
+
+    def _set_shadow_f32(self, cname: str, flat: np.ndarray):
+        """Replay the device's deterministic bf16 store of ``flat``
+        (round-to-nearest-even) — bf16-resident chunks only; the quant
+        profile replaces its shadow wholesale with the codes it uplinks
+        (the device stores those bytes verbatim, so shadow == device is
+        bit-exact by construction on both profiles)."""
+        meta = self._meta[cname]
+        assert not meta.quant_resident, (
+            "quant-resident shadows are set from the uplink codes in "
+            "_host_chunk_step, never via _set_shadow_f32")
+        self._shadow[cname] = f32_to_bf16_bits(flat)
 
     # ------------------------------------------------------------- #
     # init / chunk layout
@@ -487,13 +620,58 @@ class StreamedOffloadEngine:
             off += n
         return jax.tree.unflatten(treedef, out)
 
+    def _device_storage(self, cname: str):
+        """Host shadow -> the value held on device. bf16 profile: the bf16
+        param tree. Quant profile: a per-leaf list of {'w': bf16 array}
+        (small leaves) / {'c': codes, 's': scales} (coded leaves) — the
+        codes ARE the device-resident representation; jits dequantize to
+        bf16 transiently via _storage_to_tree."""
+        meta = self._meta[cname]
+        if not meta.quant_resident:
+            return self._chunk_to_tree_bf16(cname)
+        import ml_dtypes
+        bf = np.dtype(ml_dtypes.bfloat16)
+        leaves = jax.tree.leaves(
+            self._leaf_templates[cname],
+            is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct))
+        out = []
+        for i, entry in enumerate(self._shadow[cname]):
+            if meta.res_bits[i] < 16:
+                codes, scales = entry
+                out.append({"c": np.array(codes, copy=True),
+                            "s": np.array(scales, copy=True)})
+            else:
+                w = np.array(entry, copy=True).reshape(
+                    leaves[i].shape).view(bf)
+                out.append({"w": w})
+        return out
+
+    def _storage_to_tree(self, storage, cname: str):
+        """In-jit: device storage -> bf16 param pytree (transient)."""
+        meta = self._meta[cname]
+        if not meta.quant_resident:
+            return storage
+        template = self._leaf_templates[cname]
+        leaves, treedef = jax.tree.flatten(
+            template, is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct))
+        block = self.scfg.wire_block
+        out = []
+        for i, (t, entry) in enumerate(zip(leaves, storage)):
+            if meta.res_bits[i] < 16:
+                w = _dev_dequant(entry["c"], entry["s"], meta.sizes[i],
+                                 meta.res_bits[i], block)
+                out.append(w.reshape(t.shape).astype(jnp.bfloat16))
+            else:
+                out.append(entry["w"])
+        return jax.tree.unflatten(treedef, out)
+
     def _upload_initial(self):
         t0 = time.perf_counter()
         for g in range(self.n_groups):
             self._dev_groups.append(jax.device_put(
-                self._chunk_to_tree_bf16(f"g{g}"), self.device))
+                self._device_storage(f"g{g}"), self.device))
         self._dev_globals = jax.device_put(
-            self._chunk_to_tree_bf16("globals"), self.device)
+            self._device_storage("globals"), self.device)
         jax.block_until_ready((self._dev_groups, self._dev_globals))
         self.timings["initial_upload_s"] = time.perf_counter() - t0
 
@@ -578,6 +756,7 @@ class StreamedOffloadEngine:
 
         @jax.jit
         def f_embed(gl, tokens):
+            gl = self._storage_to_tree(gl, "globals")
             wte = gl["embed"]["wte"].astype(cdt)
             x = jnp.take(wte, tokens, axis=0)
             if not cfg.rotary:
@@ -586,10 +765,11 @@ class StreamedOffloadEngine:
 
         @jax.jit
         def f_group(gp, x):
-            return group_fwd(gp, x, positions)
+            return group_fwd(self._storage_to_tree(gp, "g0"), x, positions)
 
         @jax.jit
         def f_head_bwd(gl, x, targets):
+            gl = self._storage_to_tree(gl, "globals")
             # differentiate the tiny final_ln leaves in fp32 (their grads
             # come out full precision for free); the V x D head/embedding
             # leaves stay bf16 — an fp32 copy plus its fp32 gradient is a
@@ -606,6 +786,7 @@ class StreamedOffloadEngine:
 
         @partial(jax.jit, donate_argnums=(1, 2))
         def f_group_bwd(gp, x_in, dx, key):
+            gp = self._storage_to_tree(gp, "g0")
             _, vjp = jax.vjp(
                 lambda p, x: group_fwd(p, x, positions), gp, x_in)
             d_gp, dx_in = vjp(dx)
@@ -665,20 +846,56 @@ class StreamedOffloadEngine:
             if meta.concat:
                 pb, poff, sc, soff = meta.wire_geometry(block)
 
+            def wire_delta(packed, scales, i):
+                if meta.concat:
+                    pk = jax.lax.dynamic_slice_in_dim(
+                        packed, int(poff[i]), pb[i])
+                    sl = jax.lax.dynamic_slice_in_dim(
+                        scales, int(soff[i]), sc[i])
+                else:
+                    pk, sl = packed[i], scales[i]
+                return _dev_dequant(pk, sl, meta.sizes[i], meta.bits[i],
+                                    block)
+
+            if meta.quant_resident:
+                # the uplink IS the new resident representation (codes /
+                # raw bf16 bytes): the device stores the host's bytes
+                # verbatim with ZERO arithmetic, so shadow == device is
+                # bit-exact by construction. Same wire bytes as an int4
+                # delta would cost; no FMA-reassociation divergence (a
+                # delta+device-requant design drifts a quant level on
+                # boundary ties — measured before this design replaced it)
+                rpb, rpoff, rsc, rsoff = meta.res_geometry(block)
+                shapes = [t.shape for t in jax.tree.leaves(
+                    self._leaf_templates[cname],
+                    is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct))]
+
+                @partial(jax.jit, donate_argnums=(0,))
+                def f_apply(storage, packed, scales):
+                    del storage  # replaced wholesale
+                    out = []
+                    for i in range(len(meta.sizes)):
+                        pk = jax.lax.dynamic_slice_in_dim(
+                            packed, int(rpoff[i]), rpb[i])
+                        if meta.res_bits[i] < 16:
+                            sl = jax.lax.dynamic_slice_in_dim(
+                                scales, int(rsoff[i]), rsc[i])
+                            out.append({"c": pk, "s": sl})
+                        else:
+                            w = jax.lax.bitcast_convert_type(
+                                pk.reshape(-1, 2), jnp.bfloat16)
+                            out.append(
+                                {"w": w.reshape(shapes[i])})
+                    return out
+
+                return f_apply
+
             @partial(jax.jit, donate_argnums=(0,))
             def f_apply(tree, packed, scales):
                 leaves, treedef = jax.tree.flatten(tree)
                 out = []
                 for i, l in enumerate(leaves):
-                    if meta.concat:
-                        pk = jax.lax.dynamic_slice_in_dim(
-                            packed, int(poff[i]), pb[i])
-                        sl = jax.lax.dynamic_slice_in_dim(
-                            scales, int(soff[i]), sc[i])
-                    else:
-                        pk, sl = packed[i], scales[i]
-                    delta = _dev_dequant(
-                        pk, sl, meta.sizes[i], meta.bits[i], block)
+                    delta = wire_delta(packed, scales, i)
                     out.append(
                         (l.astype(jnp.float32)
                          + delta.reshape(l.shape)).astype(jnp.bfloat16))
@@ -715,17 +932,22 @@ class StreamedOffloadEngine:
         block = scfg.wire_block
 
         def run(states):
-            master = states["master"]
+            # the fused native pass only serves the proven fp32-state +
+            # bf16-resident profile; quant residency / bf16 host state take
+            # the numpy path below
+            native_ok = (scfg.use_native_host and not self.capture_grads
+                         and self.opt.has_native
+                         and not meta.quant_resident
+                         and scfg.host_state == "fp32")
             if meta.concat:
                 pb, poff, sc, soff = meta.wire_geometry(block)
                 pk = np.ascontiguousarray(packed.view(np.uint8))
                 sk = np.ascontiguousarray(scales, dtype=np.float32)
-                if (scfg.use_native_host and not self.capture_grads
-                        and self.opt.has_native):
+                if native_ok:
                     out_p = np.empty(int(poff[-1]), np.uint8)
                     out_s = np.empty(int(soff[-1]), np.float32)
                     if self.opt.step_stream_chunk(
-                            self.step_count, pk, sk, master,
+                            self.step_count, pk, sk, states["master"],
                             states["exp_avg"], states["exp_avg_sq"],
                             self._shadow[cname], out_p, out_s,
                             meta.sizes, meta.bits, block, lr=self._lr()):
@@ -743,10 +965,31 @@ class StreamedOffloadEngine:
                              meta.bits[i], block, out=g[o: o + n])
             if self.capture_grads:
                 self.last_grads[cname] = g.copy()
-            self.opt.step_flat(self.step_count, master, g,
-                               states["exp_avg"], states["exp_avg_sq"],
+            master = self._st_load(states["master"])
+            m = self._st_load(states["exp_avg"])
+            v = self._st_load(states["exp_avg_sq"])
+            self.opt.step_flat(self.step_count, master, g, m, v,
                                lr=self._lr())
-            shadow_f32 = bf16_bits_to_f32(self._shadow[cname])
+            self._st_writeback(states["master"], master)
+            self._st_writeback(states["exp_avg"], m)
+            self._st_writeback(states["exp_avg_sq"], v)
+            del g, m, v
+            if meta.quant_resident:
+                # uplink = the new resident representation quant(master):
+                # no delta, no error-feedback replay — the master never
+                # loses the residual, and the device stores these bytes
+                # verbatim (see make_apply's quant branch)
+                entries = self._quant_shadow_from_f32(cname, meta, master)
+                self._shadow[cname] = entries
+                payload = np.concatenate([
+                    (e[0].view(np.uint8) if isinstance(e, tuple)
+                     else np.ascontiguousarray(e).view(np.uint8))
+                    for e in entries])
+                scal = [e[1] for e in entries if isinstance(e, tuple)]
+                scal = (np.concatenate(scal) if scal
+                        else np.zeros(0, np.float32))
+                return payload, np.ascontiguousarray(scal, np.float32)
+            shadow_f32 = self._shadow_f32(cname)
             delta = master - shadow_f32
             ups, ups_s = [], []
             for i in range(len(meta.sizes)):
@@ -757,7 +1000,7 @@ class StreamedOffloadEngine:
                 # replay the device's add exactly: shadow += dequant(delta)
                 host_dequant(p, s, n, meta.bits[i], block,
                              out=delta[o: o + n])
-            self._shadow[cname] = f32_to_bf16_bits(shadow_f32 + delta)
+            self._set_shadow_f32(cname, shadow_f32 + delta)
             if meta.concat:
                 return (np.concatenate([u.view(np.uint8) for u in ups]),
                         np.concatenate(ups_s))
@@ -766,8 +1009,18 @@ class StreamedOffloadEngine:
         if self.swapper is None:
             return run(self._ram[cname])
         result: List[Any] = []
-        self.swapper.for_each_leaf(
-            [cname], lambda name, states: result.append(run(states)))
+        if scfg.swap_states == "exp_avg_sq":
+            # merged view: master+m from RAM, v from the swapper (whose
+            # for_each_leaf write-back persists the updated v)
+            def body(name, sw_states):
+                merged = dict(self._ram[cname])
+                merged.update(sw_states)
+                result.append(run(merged))
+
+            self.swapper.for_each_leaf([cname], body)
+        else:
+            self.swapper.for_each_leaf(
+                [cname], lambda name, states: result.append(run(states)))
         return result[0]
 
     # ------------------------------------------------------------- #
@@ -870,8 +1123,33 @@ class StreamedOffloadEngine:
             "chunk_sizes": {c: self._meta[c].sizes
                             for c in self.chunk_names},
             "wire_bits": self.scfg.wire_bits,
+            "wire_block": self.scfg.wire_block,  # shadow codes depend on it
             "group_layers": self.scfg.group_layers,
+            "resident_bits": self.scfg.resident_bits,
+            "host_state": self.scfg.host_state,
         }
+
+    def _save_shadow(self, tmp: str, cname: str):
+        sh = self._shadow[cname]
+        if not self._meta[cname].quant_resident:
+            np.save(os.path.join(tmp, f"{cname}.shadow.npy"), sh)
+            return
+        arrs = {}
+        for i, entry in enumerate(sh):
+            if isinstance(entry, tuple):
+                arrs[f"c{i}"], arrs[f"s{i}"] = entry
+            else:
+                arrs[f"w{i}"] = entry
+        np.savez(os.path.join(tmp, f"{cname}.shadow.npz"), **arrs)
+
+    def _load_shadow(self, ckpt: str, cname: str):
+        meta = self._meta[cname]
+        if not meta.quant_resident:
+            return np.load(os.path.join(ckpt, f"{cname}.shadow.npy"))
+        with np.load(os.path.join(ckpt, f"{cname}.shadow.npz")) as z:
+            return [
+                (z[f"c{i}"], z[f"s{i}"]) if f"c{i}" in z else z[f"w{i}"]
+                for i in range(len(meta.sizes))]
 
     def save_checkpoint(self, save_dir: str, tag: Optional[str] = None):
         """Write per-chunk host state (bf16 shadow + fp32 master/moments)
@@ -888,8 +1166,7 @@ class StreamedOffloadEngine:
         os.makedirs(tmp, exist_ok=True)
 
         def dump(cname, states):
-            np.save(os.path.join(tmp, f"{cname}.shadow.npy"),
-                    self._shadow[cname])
+            self._save_shadow(tmp, cname)
             for k in ("master", "exp_avg", "exp_avg_sq"):
                 np.save(os.path.join(tmp, f"{cname}.{k}.npy"), states[k])
 
@@ -901,8 +1178,10 @@ class StreamedOffloadEngine:
             # unchanged state back OUT after the dump, doubling save I/O
             for c in self.chunk_names:
                 buf = self.swapper.swap_in(c, async_op=False)
-                dump(c, self.swapper.unpack(c, buf))
-                del buf
+                states = dict(self._ram.get(c, {}))  # swap_states split
+                states.update(self.swapper.unpack(c, buf))
+                dump(c, states)
+                del buf, states
         meta = {
             "step_count": self.step_count,
             "rng_state": self._rng.bit_generator.state,
@@ -968,11 +1247,15 @@ class StreamedOffloadEngine:
                     for k in ("master", "exp_avg", "exp_avg_sq")}
 
         for c in self.chunk_names:
-            self._shadow[c] = np.load(
-                os.path.join(ckpt, f"{c}.shadow.npy"))
+            self._shadow[c] = self._load_shadow(ckpt, c)
             states = load_states(c)
             if self.swapper is None:
                 self._ram[c] = states
+            elif self.scfg.swap_states == "exp_avg_sq":
+                self._ram[c] = {k: states[k]
+                                for k in ("master", "exp_avg")}
+                self.swapper.register_leaf(
+                    c, {"exp_avg_sq": states["exp_avg_sq"]})
             else:
                 self.swapper.register_leaf(c, states)
             del states
@@ -991,38 +1274,73 @@ class StreamedOffloadEngine:
 
     def wire_bytes_per_step(self) -> int:
         """Bytes on the host<->device wire per step (both directions,
-        payload + scales)."""
+        payload + scales). Downlink (grads) always uses the wire bits;
+        the uplink is the wire delta for bf16-resident chunks or the new
+        resident codes for quant-resident chunks."""
+        block = self.scfg.wire_block
+
+        def geom_bytes(sizes, bits_list):
+            total = 0
+            for n, bits in zip(sizes, bits_list):
+                nb = -(-n // block)
+                padded = nb * block
+                if bits >= 16:
+                    total += bits // 8 * n
+                else:
+                    total += (padded // 2 if bits == 4 else padded) + 4 * nb
+            return total
+
         total = 0
         for cname in self.chunk_names:
             meta = self._meta[cname]
-            for n, bits in zip(meta.sizes, meta.bits):
-                nb = -(-n // self.scfg.wire_block)
-                padded = nb * self.scfg.wire_block
-                if bits >= 16:
-                    payload, sc = bits // 8 * n, 0
-                else:
-                    payload = padded // 2 if bits == 4 else padded
-                    sc = 4 * nb
-                total += payload + sc
-        return int(2 * total)
+            total += geom_bytes(meta.sizes, meta.bits)  # grads down
+            total += geom_bytes(
+                meta.sizes,
+                meta.res_bits if meta.quant_resident else meta.bits)
+        return int(total)
 
     def master_params_f32(self) -> Dict[str, np.ndarray]:
         """Host fp32 masters by chunk (test/checkpoint surface)."""
-        if self.swapper is None:
-            return {c: self._ram[c]["master"].copy()
+        def as_f32(arr):
+            return (bf16_bits_to_f32(arr) if arr.dtype == np.uint16
+                    else arr.copy())
+
+        if self.swapper is None or self.scfg.swap_states == "exp_avg_sq":
+            return {c: as_f32(self._ram[c]["master"])
                     for c in self.chunk_names}
         out = {}
         for c in self.chunk_names:
             buf = self.swapper.swap_in(c, async_op=False)
-            out[c] = self.swapper.unpack(c, buf)["master"].copy()
+            out[c] = as_f32(self.swapper.unpack(c, buf)["master"])
         return out
+
+    def _fetch_device_tree(self, storage, cname):
+        """Device storage -> host numpy param tree (dequantizing codes)."""
+        meta = self._meta[cname]
+        if not meta.quant_resident:
+            return jax.tree.map(np.asarray, storage)
+        leaves, treedef = jax.tree.flatten(
+            self._leaf_templates[cname],
+            is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct))
+        block = self.scfg.wire_block
+        out = []
+        for i, (t, entry) in enumerate(zip(leaves, storage)):
+            if meta.res_bits[i] < 16:
+                w = host_dequant(np.asarray(entry["c"]),
+                                 np.asarray(entry["s"]),
+                                 meta.sizes[i], meta.res_bits[i], block)
+                out.append(w.reshape(t.shape))
+            else:
+                out.append(np.asarray(entry["w"]))
+        return jax.tree.unflatten(treedef, out)
 
     def device_params_tree(self):
         """Reassemble the full (stacked-layer) param pytree from the device
         copies — test surface for parity with the monolithic path."""
-        lay_trees = [jax.tree.map(np.asarray, g) for g in self._dev_groups]
+        lay_trees = [self._fetch_device_tree(g, f"g{g_i}")
+                     for g_i, g in enumerate(self._dev_groups)]
         layers = jax.tree.map(lambda *xs: np.concatenate(xs, axis=0),
                               *lay_trees)
-        out = dict(jax.tree.map(np.asarray, self._dev_globals))
+        out = dict(self._fetch_device_tree(self._dev_globals, "globals"))
         out["layers"] = layers
         return out

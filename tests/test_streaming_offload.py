@@ -423,3 +423,98 @@ def test_checkpoint_resume_nvme_tier(tmp_path):
     eng2.load_checkpoint(str(tmp_path / "ck"))
     resumed = [eng2.train_batch(data[i]) for i in (1, 2)]
     np.testing.assert_array_equal(np.asarray(cont), np.asarray(resumed))
+
+
+# ------------------------------------------------------------------ #
+# quantized residency (the 20B profile: W4 codes on device) + bf16 host
+# state + v-only NVMe split
+# ------------------------------------------------------------------ #
+
+
+@pytest.mark.parametrize("res_bits", [4, 8])
+def test_quant_resident_shadow_tracks_device(monkeypatch, res_bits):
+    """The shadow==device invariant under quantized residency is BIT-exact
+    by construction: the uplink carries the new resident codes themselves
+    and the device stores those bytes verbatim (no on-device arithmetic
+    to diverge from the host's replay)."""
+    monkeypatch.setattr(streaming, "MIN_QUANT_SIZE", 0)
+    cfg = tiny_cfg(dtype=jnp.bfloat16)
+    scfg = StreamConfig(micro_batch=B, seq=S, group_layers=2, wire_bits=8,
+                        warmup_steps=0, lr=1e-3, resident_bits=res_bits)
+    eng, _ = make_engine(cfg, scfg)
+    for tok in batch(n=3):
+        eng.train_batch(tok)
+    for g_i, storage in enumerate(eng._dev_groups):
+        cname = f"g{g_i}"
+        dev_flat = np.concatenate(
+            [np.asarray(l, np.float32).reshape(-1)
+             for l in jax.tree.leaves(
+                 eng._fetch_device_tree(storage, cname))])
+        np.testing.assert_array_equal(
+            dev_flat, eng._shadow_f32(cname),
+            err_msg=f"device/shadow divergence in {cname}")
+    gl_flat = np.concatenate(
+        [np.asarray(l, np.float32).reshape(-1) for l in jax.tree.leaves(
+            eng._fetch_device_tree(eng._dev_globals, "globals"))])
+    np.testing.assert_array_equal(gl_flat, eng._shadow_f32("globals"))
+
+
+def test_quant_resident_loss_descends(monkeypatch):
+    monkeypatch.setattr(streaming, "MIN_QUANT_SIZE", 0)
+    cfg = tiny_cfg(dtype=jnp.bfloat16)
+    scfg = StreamConfig(micro_batch=B, seq=S, wire_bits=4, warmup_steps=0,
+                        lr=2e-2, resident_bits=4)
+    eng, _ = make_engine(cfg, scfg)
+    tok = batch(seed=7)[0]
+    losses = [eng.train_batch(tok) for _ in range(12)]
+    assert losses[-1] < losses[0] - 0.3, losses
+
+
+def test_bf16_host_state_and_v_swap_descends(tmp_path, monkeypatch):
+    """The 20B host budget profile: bf16 master+m in RAM, v on the NVMe
+    tier, W4 residency — trains and checkpoints/resumes bitwise."""
+    monkeypatch.setattr(streaming, "MIN_QUANT_SIZE", 0)
+    cfg = tiny_cfg(dtype=jnp.bfloat16)
+    mk = lambda folder: StreamConfig(
+        micro_batch=B, seq=S, wire_bits=4, warmup_steps=0, lr=2e-2,
+        resident_bits=4, host_state="bf16", state_device="nvme",
+        swap_states="exp_avg_sq", swap_folder=str(folder),
+        pipeline_swap=False)
+    data = batch(seed=9, n=8)
+    eng, _ = make_engine(cfg, mk(tmp_path / "s1"))
+    losses = [eng.train_batch(data[i]) for i in range(4)]
+    assert losses[-1] < losses[0], losses
+    eng.save_checkpoint(str(tmp_path / "ck"))
+    cont = [eng.train_batch(data[i]) for i in (4, 5)]
+
+    eng2, _ = make_engine(cfg, mk(tmp_path / "s2"))
+    eng2.load_checkpoint(str(tmp_path / "ck"))
+    resumed = [eng2.train_batch(data[i]) for i in (4, 5)]
+    np.testing.assert_array_equal(np.asarray(cont), np.asarray(resumed))
+
+
+def test_quant_resident_mixed_leaf_paths(monkeypatch):
+    """MIN_QUANT_SIZE at an intermediate value so a chunk holds BOTH coded
+    leaves and bf16-resident small leaves — exercising the raw bf16-byte
+    uplink slice + lax.bitcast_convert_type reassembly that an all-coded
+    (MIN_QUANT_SIZE=0) test never touches."""
+    monkeypatch.setattr(streaming, "MIN_QUANT_SIZE", 1000)
+    cfg = tiny_cfg(dtype=jnp.bfloat16)
+    scfg = StreamConfig(micro_batch=B, seq=S, group_layers=2, wire_bits=8,
+                        warmup_steps=0, lr=1e-2, resident_bits=4)
+    eng, _ = make_engine(cfg, scfg)
+    meta = eng._meta["g0"]
+    assert any(b < 16 for b in meta.res_bits), "no coded leaf in the mix"
+    assert any(b == 16 for b in meta.res_bits), "no bf16 leaf in the mix"
+    data = batch(seed=11, n=4)
+    losses = [eng.train_batch(data[i]) for i in range(4)]
+    assert losses[-1] < losses[0], losses
+    for g_i, storage in enumerate(eng._dev_groups):
+        cname = f"g{g_i}"
+        dev_flat = np.concatenate(
+            [np.asarray(l, np.float32).reshape(-1)
+             for l in jax.tree.leaves(
+                 eng._fetch_device_tree(storage, cname))])
+        np.testing.assert_array_equal(
+            dev_flat, eng._shadow_f32(cname),
+            err_msg=f"device/shadow divergence in {cname}")
