@@ -323,21 +323,28 @@ class _ChunkMeta:
                 sc, np.concatenate([[0], np.cumsum(sc)]).astype(np.int64))
 
     def res_geometry(self, block: int):
-        """Uplink geometry for quant-resident chunks, whose h2d payload is
-        the new RESIDENT representation itself: int4/int8 codes for coded
-        leaves, raw bf16 bytes (2n, no scales) for the small ones."""
-        pb, sc = [], []
+        """Resident-representation geometry for quant-resident chunks:
+        coded leaves ride a u8 codes buffer + f32 scales; small bf16
+        leaves ride a SEPARATE native-bf16 buffer ("w") — a u8->bf16
+        bitcast with a trailing dim of 2 hits the TPU's 64x lane padding
+        (13.5GB of temp measured at 20B geometry), so bf16 elements never
+        masquerade as bytes. Returns (code_bytes, code_offsets, n_scales,
+        scale_offsets, w_elems, w_offsets) per leaf; zeros in the lists
+        that don't apply to a leaf."""
+        pb, sc, wl = [], [], []
         for n, bits in zip(self.sizes, self.res_bits):
             if bits >= 16:
-                pb.append(2 * n)
+                pb.append(0)
                 sc.append(0)
+                wl.append(n)
             else:
                 nb = -(-n // block)
                 padded = nb * block
                 pb.append(padded // 2 if bits == 4 else padded)
                 sc.append(nb)
-        return (pb, np.concatenate([[0], np.cumsum(pb)]).astype(np.int64),
-                sc, np.concatenate([[0], np.cumsum(sc)]).astype(np.int64))
+                wl.append(0)
+        off = lambda v: np.concatenate([[0], np.cumsum(v)]).astype(np.int64)
+        return pb, off(pb), sc, off(sc), wl, off(wl)
 
 
 class StreamedOffloadEngine:
@@ -620,31 +627,35 @@ class StreamedOffloadEngine:
             off += n
         return jax.tree.unflatten(treedef, out)
 
+    def _shadow_payload(self, cname: str):
+        """Quant-profile shadow -> {'c': u8 codes, 's': f32 scales,
+        'w': bf16 small leaves} — the exact buffers held on device AND
+        sent as the uplink after every host step."""
+        import ml_dtypes
+        bf = np.dtype(ml_dtypes.bfloat16)
+        entries = self._shadow[cname]
+        codes = [e[0] for e in entries if isinstance(e, tuple)]
+        scal = [e[1] for e in entries if isinstance(e, tuple)]
+        ws = [np.ascontiguousarray(e).view(bf)
+              for e in entries if not isinstance(e, tuple)]
+        cat = lambda xs, dt: (np.concatenate(xs) if xs
+                              else np.zeros(0, dt))
+        return {"c": cat(codes, np.uint8),
+                "s": np.ascontiguousarray(cat(scal, np.float32),
+                                          np.float32),
+                "w": cat(ws, bf)}
+
     def _device_storage(self, cname: str):
         """Host shadow -> the value held on device. bf16 profile: the bf16
-        param tree. Quant profile: a per-leaf list of {'w': bf16 array}
-        (small leaves) / {'c': codes, 's': scales} (coded leaves) — the
-        codes ARE the device-resident representation; jits dequantize to
-        bf16 transiently via _storage_to_tree."""
+        param tree. Quant profile: ONE concatenated u8 codes buffer + ONE
+        f32 scales buffer — per-leaf slicing and dequantization happen
+        INSIDE the compute jits (_storage_to_tree), fused with real work;
+        a standalone split/apply kernel measured 13.5GB of TPU temp at 20B
+        geometry (byte-type relayout), so there isn't one."""
         meta = self._meta[cname]
         if not meta.quant_resident:
             return self._chunk_to_tree_bf16(cname)
-        import ml_dtypes
-        bf = np.dtype(ml_dtypes.bfloat16)
-        leaves = jax.tree.leaves(
-            self._leaf_templates[cname],
-            is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct))
-        out = []
-        for i, entry in enumerate(self._shadow[cname]):
-            if meta.res_bits[i] < 16:
-                codes, scales = entry
-                out.append({"c": np.array(codes, copy=True),
-                            "s": np.array(scales, copy=True)})
-            else:
-                w = np.array(entry, copy=True).reshape(
-                    leaves[i].shape).view(bf)
-                out.append({"w": w})
-        return out
+        return self._shadow_payload(cname)
 
     def _storage_to_tree(self, storage, cname: str):
         """In-jit: device storage -> bf16 param pytree (transient)."""
@@ -655,14 +666,21 @@ class StreamedOffloadEngine:
         leaves, treedef = jax.tree.flatten(
             template, is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct))
         block = self.scfg.wire_block
+        rpb, rpoff, rsc, rsoff, wl, woff = meta.res_geometry(block)
         out = []
-        for i, (t, entry) in enumerate(zip(leaves, storage)):
+        for i, t in enumerate(leaves):
             if meta.res_bits[i] < 16:
-                w = _dev_dequant(entry["c"], entry["s"], meta.sizes[i],
+                pk = jax.lax.slice_in_dim(storage["c"], int(rpoff[i]),
+                                          int(rpoff[i]) + rpb[i])
+                sl = jax.lax.slice_in_dim(storage["s"], int(rsoff[i]),
+                                          int(rsoff[i]) + rsc[i])
+                w = _dev_dequant(pk, sl, meta.sizes[i],
                                  meta.res_bits[i], block)
                 out.append(w.reshape(t.shape).astype(jnp.bfloat16))
             else:
-                out.append(entry["w"])
+                w = jax.lax.slice_in_dim(storage["w"], int(woff[i]),
+                                         int(woff[i]) + wl[i])
+                out.append(w.reshape(t.shape))
         return jax.tree.unflatten(treedef, out)
 
     def _upload_initial(self):
@@ -858,37 +876,11 @@ class StreamedOffloadEngine:
                                     block)
 
             if meta.quant_resident:
-                # the uplink IS the new resident representation (codes /
-                # raw bf16 bytes): the device stores the host's bytes
-                # verbatim with ZERO arithmetic, so shadow == device is
-                # bit-exact by construction. Same wire bytes as an int4
-                # delta would cost; no FMA-reassociation divergence (a
-                # delta+device-requant design drifts a quant level on
-                # boundary ties — measured before this design replaced it)
-                rpb, rpoff, rsc, rsoff = meta.res_geometry(block)
-                shapes = [t.shape for t in jax.tree.leaves(
-                    self._leaf_templates[cname],
-                    is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct))]
-
-                @partial(jax.jit, donate_argnums=(0,))
-                def f_apply(storage, packed, scales):
-                    del storage  # replaced wholesale
-                    out = []
-                    for i in range(len(meta.sizes)):
-                        pk = jax.lax.dynamic_slice_in_dim(
-                            packed, int(rpoff[i]), rpb[i])
-                        if meta.res_bits[i] < 16:
-                            sl = jax.lax.dynamic_slice_in_dim(
-                                scales, int(rsoff[i]), rsc[i])
-                            out.append({"c": pk, "s": sl})
-                        else:
-                            w = jax.lax.bitcast_convert_type(
-                                pk.reshape(-1, 2), jnp.bfloat16)
-                            out.append(
-                                {"w": w.reshape(shapes[i])})
-                    return out
-
-                return f_apply
+                # quant chunks have NO apply kernel: the uplink bytes ARE
+                # the new device storage (train_batch device_puts them
+                # directly) — shadow == device bit-exact by construction,
+                # zero device arithmetic, zero TPU byte-relayout temps
+                return None
 
             @partial(jax.jit, donate_argnums=(0,))
             def f_apply(tree, packed, scales):
@@ -978,17 +970,10 @@ class StreamedOffloadEngine:
                 # uplink = the new resident representation quant(master):
                 # no delta, no error-feedback replay — the master never
                 # loses the residual, and the device stores these bytes
-                # verbatim (see make_apply's quant branch)
-                entries = self._quant_shadow_from_f32(cname, meta, master)
-                self._shadow[cname] = entries
-                payload = np.concatenate([
-                    (e[0].view(np.uint8) if isinstance(e, tuple)
-                     else np.ascontiguousarray(e).view(np.uint8))
-                    for e in entries])
-                scal = [e[1] for e in entries if isinstance(e, tuple)]
-                scal = (np.concatenate(scal) if scal
-                        else np.zeros(0, np.float32))
-                return payload, np.ascontiguousarray(scal, np.float32)
+                # verbatim (train_batch device_puts them as the storage)
+                self._shadow[cname] = self._quant_shadow_from_f32(
+                    cname, meta, master)
+                return self._shadow_payload(cname), None
             shadow_f32 = self._shadow_f32(cname)
             delta = master - shadow_f32
             ups, ups_s = [], []
@@ -1080,10 +1065,14 @@ class StreamedOffloadEngine:
             t["host_opt_s"] += time.perf_counter() - t0
 
             t0 = time.perf_counter()
-            up_d = jax.device_put(_wire(up), self.device)
-            ups_d = jax.device_put(_wire(up_s), self.device)
-            self._dev_groups[g] = fns["apply_g"](
-                self._dev_groups[g], up_d, ups_d)
+            if self._meta[f"g{g}"].quant_resident:
+                # the uplink buffers ARE the new storage — no apply kernel
+                self._dev_groups[g] = jax.device_put(up, self.device)
+            else:
+                up_d = jax.device_put(_wire(up), self.device)
+                ups_d = jax.device_put(_wire(up_s), self.device)
+                self._dev_groups[g] = fns["apply_g"](
+                    self._dev_groups[g], up_d, ups_d)
             jax.block_until_ready(self._dev_groups[g])
             t["h2d_s"] += time.perf_counter() - t0
 
@@ -1100,10 +1089,13 @@ class StreamedOffloadEngine:
         up, up_s = self._host_chunk_step("globals", p_host, s_host)
         t["host_opt_s"] += time.perf_counter() - t0
         t0 = time.perf_counter()
-        self._dev_globals = fns["apply_globals"](
-            self._dev_globals,
-            jax.device_put(_wire(up), self.device),
-            jax.device_put(_wire(up_s), self.device))
+        if self._meta["globals"].quant_resident:
+            self._dev_globals = jax.device_put(up, self.device)
+        else:
+            self._dev_globals = fns["apply_globals"](
+                self._dev_globals,
+                jax.device_put(_wire(up), self.device),
+                jax.device_put(_wire(up_s), self.device))
         jax.block_until_ready(self._dev_globals)
         t["h2d_s"] += time.perf_counter() - t0
 
@@ -1278,25 +1270,27 @@ class StreamedOffloadEngine:
         the uplink is the wire delta for bf16-resident chunks or the new
         resident codes for quant-resident chunks."""
         block = self.scfg.wire_block
-
-        def geom_bytes(sizes, bits_list):
-            total = 0
-            for n, bits in zip(sizes, bits_list):
-                nb = -(-n // block)
-                padded = nb * block
-                if bits >= 16:
-                    total += bits // 8 * n
-                else:
-                    total += (padded // 2 if bits == 4 else padded) + 4 * nb
-            return total
-
         total = 0
         for cname in self.chunk_names:
             meta = self._meta[cname]
-            total += geom_bytes(meta.sizes, meta.bits)  # grads down
-            total += geom_bytes(
-                meta.sizes,
-                meta.res_bits if meta.quant_resident else meta.bits)
+            # grads down: the wire geometry (bf16/fp32 profiles carry
+            # bits//8*n per leaf with no scales — wire_geometry only
+            # describes the concat profiles, so fall back per leaf)
+            if meta.concat:
+                pb, _, sc, _ = meta.wire_geometry(block)
+                total += sum(pb) + 4 * sum(sc)
+            else:
+                total += sum((b // 8) * n
+                             for n, b in zip(meta.sizes, meta.bits))
+            if meta.quant_resident:  # uplink = resident representation
+                rpb, _, rsc, _, wl, _ = meta.res_geometry(block)
+                total += sum(rpb) + 4 * sum(rsc) + 2 * sum(wl)
+            elif meta.concat:
+                pb, _, sc, _ = meta.wire_geometry(block)
+                total += sum(pb) + 4 * sum(sc)
+            else:
+                total += sum((b // 8) * n
+                             for n, b in zip(meta.sizes, meta.bits))
         return int(total)
 
     def master_params_f32(self) -> Dict[str, np.ndarray]:
@@ -1323,15 +1317,21 @@ class StreamedOffloadEngine:
             self._leaf_templates[cname],
             is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct))
         block = self.scfg.wire_block
+        rpb, rpoff, rsc, rsoff, wl, woff = meta.res_geometry(block)
+        payload = np.asarray(storage["c"])
+        scal = np.asarray(storage["s"])
+        wbuf = np.asarray(storage["w"])
         out = []
-        for i, (t, entry) in enumerate(zip(leaves, storage)):
+        for i, t in enumerate(leaves):
             if meta.res_bits[i] < 16:
-                w = host_dequant(np.asarray(entry["c"]),
-                                 np.asarray(entry["s"]),
-                                 meta.sizes[i], meta.res_bits[i], block)
+                pk = payload[int(rpoff[i]): int(rpoff[i]) + rpb[i]]
+                sl = scal[int(rsoff[i]): int(rsoff[i]) + rsc[i]]
+                w = host_dequant(pk, sl, meta.sizes[i], meta.res_bits[i],
+                                 block)
                 out.append(w.reshape(t.shape))
             else:
-                out.append(np.asarray(entry["w"]))
+                wseg = wbuf[int(woff[i]): int(woff[i]) + wl[i]]
+                out.append(np.asarray(wseg, np.float32).reshape(t.shape))
         return jax.tree.unflatten(treedef, out)
 
     def device_params_tree(self):

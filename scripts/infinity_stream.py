@@ -30,13 +30,20 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="6.7b",
-                    choices=["125m", "1.3b", "6.7b"])
+                    choices=["125m", "1.3b", "6.7b", "20b"])
     ap.add_argument("--steps", type=int, default=12)
     ap.add_argument("--micro-batch", type=int, default=1)
     ap.add_argument("--seq", type=int, default=2048)
     ap.add_argument("--group-layers", type=int, default=1)
     ap.add_argument("--wire-bits", type=int, default=4)
     ap.add_argument("--state", default="cpu", choices=["cpu", "nvme"])
+    # the 20B single-chip profile: int4-resident device params (41GB of
+    # bf16 cannot hold a 16GB chip), bf16 host master+momentum, v on NVMe
+    ap.add_argument("--resident-bits", type=int, default=16)
+    ap.add_argument("--host-state", default="fp32",
+                    choices=["fp32", "bf16"])
+    ap.add_argument("--swap-states", default="all",
+                    choices=["all", "exp_avg_sq"])
     # Adam's first steps are near-sign-steps (|update| = lr/param while v-hat
     # adapts): at billion-param scale the global jump lr*sqrt(N) transiently
     # SPIKES the loss at any headline lr (reproduced with the regular
@@ -73,7 +80,7 @@ def main():
         StreamConfig, StreamedOffloadEngine)
 
     preset = {"125m": "neox-125m", "1.3b": "neox-1.3b",
-              "6.7b": "neox-6.7b"}[args.model]
+              "6.7b": "neox-6.7b", "20b": "neox-20b"}[args.model]
     # tied embeddings: the lm_head's 412MB has no business in a 15GB budget
     cfg = get_preset(preset, tie_embeddings=True, remat=True,
                      dtype=jnp.bfloat16, attn_impl="auto", ce_chunk=128,
@@ -82,6 +89,8 @@ def main():
         micro_batch=args.micro_batch, seq=args.seq,
         group_layers=args.group_layers, wire_bits=args.wire_bits,
         state_device=args.state, lr=args.lr, warmup_steps=args.warmup,
+        resident_bits=args.resident_bits, host_state=args.host_state,
+        swap_states=args.swap_states,
     )
 
     print(f"[infinity_stream] building {preset} engine "
@@ -151,6 +160,9 @@ def main():
         "micro_batch": B, "seq": S,
         "wire_bits": args.wire_bits,
         "state_device": args.state,
+        "resident_bits": args.resident_bits,
+        "host_state": args.host_state,
+        "swap_states": args.swap_states,
         "steps": args.steps,
         "start_step": start_step,
         "fixed_batch": bool(args.fixed_batch),

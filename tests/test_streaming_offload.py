@@ -495,9 +495,9 @@ def test_bf16_host_state_and_v_swap_descends(tmp_path, monkeypatch):
 
 def test_quant_resident_mixed_leaf_paths(monkeypatch):
     """MIN_QUANT_SIZE at an intermediate value so a chunk holds BOTH coded
-    leaves and bf16-resident small leaves — exercising the raw bf16-byte
-    uplink slice + lax.bitcast_convert_type reassembly that an all-coded
-    (MIN_QUANT_SIZE=0) test never touches."""
+    leaves and bf16-resident small leaves — exercising the native-bf16
+    'w' buffer slicing (and its uplink/storage round trip) that an
+    all-coded (MIN_QUANT_SIZE=0) test never touches."""
     monkeypatch.setattr(streaming, "MIN_QUANT_SIZE", 1000)
     cfg = tiny_cfg(dtype=jnp.bfloat16)
     scfg = StreamConfig(micro_batch=B, seq=S, group_layers=2, wire_bits=8,
