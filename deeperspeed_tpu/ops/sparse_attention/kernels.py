@@ -1043,6 +1043,47 @@ def _bs_bwd_res(res, g, lut, lut_t, sm_scale, block, chunk, causal, srow,
 # ------------------------------------------------------------------ #
 
 
+# Measured on v5e (BENCH_EXTRA r3/r4): the streaming sparse kernels beat
+# DENSE flash only below ~12% effective density; above it, computing the
+# full S^2 on flash is faster than gathering the sparse blocks. auto CANNOT
+# route to flash — dense attention attends positions the layout masks out,
+# and the mask is model semantics, not an optimization — so above the
+# break-even the honest answer is: this layout's sparsity does not pay on
+# this chip (auto_route reports the prediction; the bench labels it).
+FLASH_DENSITY_BREAK_EVEN = 0.12
+
+
+def auto_route(layout: np.ndarray, causal: bool, S: int,
+               Dh: int, dtype=jnp.bfloat16):
+    """What impl='auto' executes for this layout/geometry, with the
+    numbers behind it: (impl, waste, density, dense_flash_predicted_faster)
+    where impl is 'resident'|'stream'. Mirrors
+    make_block_sparse_attention's dispatch (kept in sync by
+    tests/test_sparse_attention.py) — benchmark/report introspection."""
+    lay = np.asarray(layout)
+    H, nb, _ = lay.shape
+    chunk = min(CHUNK, nb)
+    srow = _pick_tile(nb, SROW)
+    lay_c = lay
+    denom = H * nb * nb
+    if causal:
+        tri = np.tril(np.ones((nb, nb), bool))
+        lay_c = lay * tri
+        denom = H * int(tri.sum())
+    waste = supertile_waste(lay_c, chunk, srow)
+    density = float((lay_c != 0).sum()) / denom
+    itemsize = jnp.dtype(dtype).itemsize
+    impl = ("resident"
+            if resident_ok(S, Dh, itemsize) and waste <= 2.0 else "stream")
+    from ..pallas.flash_attention import is_available
+
+    probe = jax.ShapeDtypeStruct((1, S, H, Dh), jnp.dtype(dtype))
+    flash_faster = bool(
+        impl == "stream" and density >= FLASH_DENSITY_BREAK_EVEN
+        and is_available(probe))
+    return impl, waste, density, flash_faster
+
+
 def make_block_sparse_attention(layout: np.ndarray, block: int,
                                 causal: bool = False, sm_scale: float = None,
                                 interpret: bool = False, impl: str = "auto"):
@@ -1161,6 +1202,8 @@ def make_block_sparse_attention(layout: np.ndarray, block: int,
 
     attend.defvjp(fwd, bwd)
 
+    _hinted = [False]
+
     def checked(q, k, v):
         B, S, Hq, Dh = q.shape
         if Hq != H:
@@ -1169,6 +1212,22 @@ def make_block_sparse_attention(layout: np.ndarray, block: int,
             raise ValueError(
                 f"layout built for seq len {nb * block} (block {block}), got {S}"
             )
+        if impl == "auto" and not _hinted[0]:
+            _hinted[0] = True
+            route, waste, density, flash_faster = auto_route(
+                layout, causal, S, Dh, q.dtype)
+            if flash_faster:
+                from ...utils.logging import logger
+
+                logger.info(
+                    "block-sparse auto: layout density %.3f is above the "
+                    "measured ~%.2f break-even where DENSE flash outruns "
+                    "the sparse kernels on this chip (waste %.2f rules "
+                    "out the resident path). Sparsity is not buying "
+                    "speed here — if the mask is only an approximation "
+                    "for you, dense flash_attention is faster; the mask "
+                    "SEMANTICS are preserved on the %s sparse path.",
+                    density, FLASH_DENSITY_BREAK_EVEN, waste, route)
         return attend(q, k, v)
 
     return checked

@@ -48,7 +48,8 @@ def peak_tflops():
 
 
 def bench_bert(seq: int, micro: int, steps: int, warmup: int,
-               remat=True, remat_policy="full", gather=0.0):
+               remat=True, remat_policy="full", gather=0.0,
+               ce_chunk=64, masterless=False, zero_stage=2):
     """BERT-large MLM training step through the engine, ZeRO-2 + bf16.
 
     Perf config (round 3, within-process A/B on the chip): attn_impl
@@ -65,7 +66,7 @@ def bench_bert(seq: int, micro: int, steps: int, warmup: int,
         vocab_size=30528,  # padded to a lane multiple
         n_layer=24, n_head=16, d_model=1024, max_seq=seq,
         dtype=jnp.bfloat16, remat=remat, remat_policy=remat_policy,
-        ce_chunk=64, mlm_gather_frac=gather,
+        ce_chunk=ce_chunk, mlm_gather_frac=gather,
     )
     init_fn, _, mlm_loss_fn, _ = make_bert(cfg)
     params = init_fn(jax.random.PRNGKey(0))
@@ -80,8 +81,9 @@ def bench_bert(seq: int, micro: int, steps: int, warmup: int,
             "gradient_accumulation_steps": 1,
             "optimizer": {"type": "Adam",
                           "params": {"lr": 1e-4, "betas": [0.9, 0.95]}},
-            "bf16": {"enabled": True},
-            "zero_optimization": {"stage": 2},
+            "bf16": {"enabled": True,
+                     "master_weights": not masterless},
+            "zero_optimization": {"stage": zero_stage},
             "gradient_clipping": 1.0,
             "steps_per_print": 10**9,
         },
@@ -187,10 +189,21 @@ def bench_sparse_vs_dense(S: int, steps: int, sparsity_cfg=None,
         return jnp.einsum("bhqk,bhkd->bhqd", p.astype(qh.dtype), vh)
 
     t_naive = None if skip_naive else time_fn(naive)
+    from deeperspeed_tpu.ops.sparse_attention.kernels import auto_route
+
+    routed, waste, _, flash_hint = auto_route(layout, True, S, Dh)
     row = {
         "seq": S, "heads": H, "head_dim": Dh,
         "layout": type(sparsity_cfg).__name__,
         "layout_density": round(density, 4),
+        # which SPARSE path auto executes (masking semantics preserved),
+        # plus the honest prediction: above the ~12% density break-even
+        # dense flash outruns both sparse kernels on this chip — a model
+        # whose mask is semantic still gets the sparse path; one using
+        # sparsity purely for speed should use dense flash instead
+        "auto_impl": routed,
+        "supertile_waste": round(waste, 2),
+        "dense_flash_predicted_faster": flash_hint,
         "block_sparse_ms": round(t_sparse * 1e3, 3),
         "reference_claim": ("up to 6.3x vs dense (V100, long sequences; "
                             "dense == materialized-softmax in 2020)"),
@@ -223,7 +236,7 @@ def main():
         out["bert_large_zero2"].append(r)
         print(json.dumps(r), flush=True)
     from deeperspeed_tpu.ops.sparse_attention import (
-        LocalSlidingWindowSparsityConfig)
+        BigBirdSparsityConfig, LocalSlidingWindowSparsityConfig)
 
     H = 16
     sweep = [
@@ -245,6 +258,16 @@ def main():
         # where flash itself is VMEM-capped out entirely
         (16384, LocalSlidingWindowSparsityConfig(
             num_heads=H, block=128, num_sliding_window_blocks=14)),
+        # BigBird (window + random + global) — the r3 verdict's missing
+        # measurement; window-dominated so auto should keep it sparse
+        (4096, BigBirdSparsityConfig(
+            num_heads=H, block=128, num_random_blocks=1,
+            num_sliding_window_blocks=3, num_global_blocks=1,
+            attention="unidirectional")),
+        (8192, BigBirdSparsityConfig(
+            num_heads=H, block=128, num_random_blocks=1,
+            num_sliding_window_blocks=3, num_global_blocks=1,
+            attention="unidirectional")),
     ]
     for S, scfg in sweep:
         # steps=16: the harness carries a measured ~5ms fixed cost per scan

@@ -1,0 +1,133 @@
+"""Build the vendored real-language corpus + tokenizer for the convergence
+gate (VERDICT r3 item 7: every convergence gate so far trained on synthetic
+tokens; the reference's model gate trains on a real corpus,
+tests/model/Megatron_GPT2/run_func_test.py).
+
+This container has zero egress, so the corpus is harvested from real
+English text already in the image: module docstrings and comments from the
+Python stdlib + installed packages, plus markdown/rst docs and license
+texts. That is genuine natural language (Zipf unigrams, long-range
+structure, real punctuation), which is what the gate needs — embedding
+gradient sparsity and loss-scale dynamics behave nothing like periodic or
+uniform synthetic tokens.
+
+Outputs (committed):
+  data/corpus_tokenizer.json  — byte-level BPE (vocab 16384) trained here
+  data/corpus_tokens.npy      — the tokenized stream (uint16)
+
+Usage: python scripts/build_corpus.py [--target-mb 12]
+"""
+
+import argparse
+import ast
+import glob
+import io
+import os
+import re
+import sys
+import tokenize
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SP = "/opt/venv/lib/python3.12/site-packages"
+STDLIB = "/usr/local/lib/python3.12"
+
+
+def doc_and_comments(path):
+    """Docstrings + comment lines of one python file, as prose."""
+    try:
+        with open(path, "r", encoding="utf-8", errors="ignore") as f:
+            src = f.read()
+    except OSError:
+        return ""
+    out = []
+    try:
+        tree = ast.parse(src)
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.Module, ast.ClassDef, ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                d = ast.get_docstring(node)
+                if d and len(d) > 40:
+                    out.append(d)
+    except SyntaxError:
+        return ""
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(src).readline):
+            if tok.type == tokenize.COMMENT:
+                c = tok.string.lstrip("# ")
+                if len(c) > 30 and not c.startswith("!"):
+                    out.append(c)
+    except (tokenize.TokenizeError, IndentationError):
+        pass
+    return "\n".join(out)
+
+
+def harvest(target_bytes):
+    chunks = []
+    total = 0
+    # prose docs first (highest naturalness)
+    for pat in ("**/*.md", "**/*.rst"):
+        for f in sorted(glob.glob(os.path.join(SP, pat), recursive=True)):
+            try:
+                t = open(f, encoding="utf-8", errors="ignore").read()
+            except OSError:
+                continue
+            if len(t) > 1000:
+                chunks.append(t)
+                total += len(t)
+    # then docstrings/comments, stdlib before site-packages (cleaner prose)
+    pys = (sorted(glob.glob(os.path.join(STDLIB, "*.py")))
+           + sorted(glob.glob(os.path.join(STDLIB, "*/*.py")))
+           + sorted(glob.glob(os.path.join(SP, "*/*.py")))
+           + sorted(glob.glob(os.path.join(SP, "*/*/*.py"))))
+    for f in pys:
+        if total >= target_bytes:
+            break
+        t = doc_and_comments(f)
+        if len(t) > 200:
+            chunks.append(t)
+            total += len(t)
+    text = "\n\n".join(chunks)
+    # normalize whitespace runs; keep natural punctuation/casing
+    text = re.sub(r"[ \t]+", " ", text)
+    text = re.sub(r"\n{3,}", "\n\n", text)
+    return text
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--target-mb", type=float, default=12.0)
+    ap.add_argument("--vocab", type=int, default=16384)
+    args = ap.parse_args()
+
+    text = harvest(int(args.target_mb * 1e6))
+    print(f"corpus: {len(text) / 1e6:.1f} MB of text")
+
+    from tokenizers import Tokenizer, models, pre_tokenizers, trainers
+
+    tok = Tokenizer(models.BPE(unk_token=None))
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    trainer = trainers.BpeTrainer(
+        vocab_size=args.vocab, special_tokens=["<|endoftext|>"],
+        initial_alphabet=pre_tokenizers.ByteLevel.alphabet())
+    tok.train_from_iterator(
+        (text[i:i + 1 << 16] for i in range(0, len(text), 1 << 16)),
+        trainer=trainer)
+
+    import numpy as np
+
+    ids = []
+    for i in range(0, len(text), 1 << 20):
+        ids.extend(tok.encode(text[i:i + 1 << 20]).ids)
+    ids = np.asarray(ids, np.uint16)
+    os.makedirs(os.path.join(REPO, "data"), exist_ok=True)
+    tok.save(os.path.join(REPO, "data", "corpus_tokenizer.json"))
+    np.save(os.path.join(REPO, "data", "corpus_tokens.npy"), ids)
+    # report the statistics that make this a REAL-language gate
+    uniq, counts = np.unique(ids, return_counts=True)
+    top = counts.max() / ids.size
+    print(f"tokens: {ids.size:,}; vocab used {uniq.size}/{args.vocab}; "
+          f"top-token mass {top:.3f} (Zipf-like expected ~0.03-0.08)")
+
+
+if __name__ == "__main__":
+    main()

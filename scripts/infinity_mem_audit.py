@@ -170,6 +170,7 @@ def main():
     # quant-resident uplink buffers use the res geometry
     if not g_meta.quant_resident:
         up_g, upscal_g = wire_g, scal_g
+    if not gl_meta.quant_resident:
         up_gl, upscal_gl = wire_gl, scal_gl
     for name, lowered in (
         ("embed", fns["embed"].lower(gl_st, tok_s)),

@@ -303,3 +303,62 @@ def test_kernel_two_word_bitmap_super_tiles(monkeypatch):
     for a, b in zip(g_sparse, g_ref):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=5e-4,
                                    atol=5e-4)
+
+
+def test_auto_route_reports_flash_hint():
+    """auto_route must preserve mask semantics (impl is always a SPARSE
+    path) and report, not act on, the dense-flash break-even prediction."""
+    import numpy as np
+    from deeperspeed_tpu.ops.sparse_attention import kernels as K
+
+    H, nb, block, Dh = 2, 8, 128, 64
+    S = nb * block
+    # strided columns + local diagonal: high waste, density well above 0.12
+    layout = np.zeros((H, nb, nb), np.int64)
+    for i in range(nb):
+        layout[:, i, max(0, i - 1):i + 1] = 1
+        layout[:, i, ::4] = 1
+    import deeperspeed_tpu.ops.pallas.flash_attention as FA
+
+    orig = FA.is_available
+    FA.is_available = lambda probe: True
+    try:
+        K.resident_ok, orig_res = (lambda *a, **k: False), K.resident_ok
+        try:
+            impl, waste, density, flash_hint = K.auto_route(
+                layout, True, S, Dh)
+        finally:
+            K.resident_ok = orig_res
+    finally:
+        FA.is_available = orig
+    assert impl in ("resident", "stream")
+    assert flash_hint and density >= K.FLASH_DENSITY_BREAK_EVEN
+    # low-density window layout: no hint, resident path
+    win = np.zeros((H, nb, nb), np.int64)
+    for i in range(nb):
+        win[:, i, max(0, i - 1):i + 1] = 1
+    impl2, _, _, hint2 = K.auto_route(win, True, S, Dh)
+    assert impl2 in ("resident", "stream") and not hint2
+
+
+def test_auto_never_changes_semantics():
+    """impl='auto' output must equal the masked XLA reference even when
+    the dense-flash hint fires (routing to dense would attend masked
+    positions — a correctness bug, not an optimization)."""
+    import numpy as np
+    from deeperspeed_tpu.ops.sparse_attention.kernels import (
+        block_sparse_attention_xla, make_block_sparse_attention)
+
+    H, nb, block, Dh = 2, 4, 128, 32
+    S = nb * block
+    layout = np.zeros((H, nb, nb), np.int64)
+    for i in range(nb):
+        layout[:, i, :i + 1:2] = 1
+        layout[:, i, i] = 1
+    fn = make_block_sparse_attention(layout, block, causal=True,
+                                     impl="auto", interpret=True)
+    q = jax.random.normal(jax.random.PRNGKey(1), (1, S, H, Dh), jnp.float32)
+    out = fn(q, q, q)
+    ref = block_sparse_attention_xla(q, q, q, layout, block, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-2, rtol=2e-2)
