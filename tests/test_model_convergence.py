@@ -224,3 +224,19 @@ def test_long_horizon_masterless_bf16_tracks_fp32_master(long_baseline):
     assert tail < losses[0] * 0.5
     assert abs(tail - base_tail) / max(base_tail, 0.25) < 0.10, (
         tail, base_tail)
+
+
+def test_long_horizon_masterless_bf16_zero2(long_baseline):
+    """Masterless bf16 UNDER ZERO-2 — the exact configuration the BERT
+    headline bench reports (bert_sparse_bench masterless=True, stage 2):
+    sharded bf16 moments + grad partitioning with no fp32 master must
+    track the fp32 baseline like the stage-1 case does."""
+    losses = _long_losses({
+        "bf16": {"enabled": True, "master_weights": False},
+        "zero_optimization": {"stage": 2},
+    })
+    base_tail = np.mean(long_baseline[-LONG_TAIL:])
+    tail = np.mean(losses[-LONG_TAIL:])
+    assert tail < losses[0] * 0.5
+    assert abs(tail - base_tail) / max(base_tail, 0.25) < 0.10, (
+        tail, base_tail)
