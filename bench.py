@@ -180,6 +180,7 @@ def main():
     # single-chip ZeRO-Infinity streaming demo (scripts/infinity_stream.py)
     # and the 1-bit Adam bytes-on-wire audit (scripts/onebit_wire_bytes.py)
     for key, fname in (("zero_infinity_6p7b", "INFINITY_RUN.json"),
+                       ("zero_infinity_20b", "INFINITY_20B.json"),
                        ("onebit_wire", "ONEBIT_WIRE.json")):
         p = os.path.join(here, fname)
         if os.path.isfile(p):
