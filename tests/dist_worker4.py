@@ -1,0 +1,148 @@
+"""4-process worker (VERDICT r3 item 10): coordinator fan-out beyond pairs.
+
+Launched by deeperspeed_tpu.launcher.launch with procs_per_node=4; each
+process holds ONE CPU device and rendezvouses through init_distributed.
+Two legs:
+
+  1. dp=4 engine training vs a single-device reference (loss parity) —
+     the 4-way generalization of dist_worker.py's phase 1.
+  2. pp2 x dp2 SPMD 1F1B pipeline: the 'pipe' axis spans process pairs
+     and 'data' spans the other dimension — stage p2p (lax.ppermute) and
+     the gradient pmean both cross process boundaries in one program.
+
+Writes "PARITY4-OK <losses...>" to the result file from rank 0.
+"""
+
+import sys
+
+from deeperspeed_tpu.utils.distributed import init_distributed
+
+ok = init_distributed()  # must run before jax initializes its backend
+assert ok, "init_distributed() fell back to single-process"
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+import deeperspeed_tpu as ds  # noqa: E402
+from deeperspeed_tpu.ops import FusedAdam  # noqa: E402
+from deeperspeed_tpu.parallel import build_mesh  # noqa: E402
+
+LR, STEPS = 1e-2, 8
+
+
+def model_params():
+    k = jax.random.split(jax.random.PRNGKey(0), 2)
+    return {
+        "w": jax.random.normal(k[0], (16, 4), jnp.float32) * 0.2,
+        "b": jnp.zeros((4,), jnp.float32),
+    }
+
+
+def loss_fn(p, batch):
+    x, y = batch
+    return jnp.mean((x @ p["w"] + p["b"] - y) ** 2)
+
+
+def data():
+    r = np.random.default_rng(0)
+    x = jnp.asarray(r.normal(size=(32, 16)), jnp.float32)
+    w = jnp.asarray(r.normal(size=(16, 4)), jnp.float32)
+    return x, x @ w
+
+
+def main():
+    result_file = sys.argv[1]
+    assert jax.process_count() == 4, jax.process_count()
+    assert len(jax.devices()) == 4, jax.devices()
+
+    # ---- leg 1: dp=4 engine parity ----
+    mesh = build_mesh({"data": 4})
+    engine, _, _, _ = ds.initialize(
+        model=loss_fn,
+        model_parameters=model_params(),
+        config={
+            "train_micro_batch_size_per_gpu": 8,
+            "gradient_accumulation_steps": 1,
+            "optimizer": {"type": "Adam", "params": {"lr": LR}},
+            "zero_optimization": {"stage": 1},
+        },
+        mesh=mesh,
+    )
+    x, y = data()
+    dist_losses = [
+        float(jax.device_get(engine.train_batch((x, y))))
+        for _ in range(STEPS)
+    ]
+    opt = FusedAdam(lr=LR)
+    params = model_params()
+    opt_state = opt.init(params)
+    ref_losses = []
+    for _ in range(STEPS):
+        loss, grads = jax.value_and_grad(loss_fn)(params, (x, y))
+        params, opt_state = opt.update(grads, opt_state, params,
+                                       lr=jnp.float32(LR))
+        ref_losses.append(float(loss))
+    np.testing.assert_allclose(dist_losses, ref_losses, rtol=1e-4,
+                               atol=1e-6)
+
+    # ---- leg 2: pp2 x dp2 across the 4 processes ----
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from deeperspeed_tpu.runtime.pipe.spmd import (
+        make_spmd_pipeline_train_step)
+
+    pmesh = build_mesh({"pipe": 2, "data": 2})
+
+    def stage_fn(p, h):
+        return jnp.tanh(h @ p["w"] + p["b"])
+
+    S_, D_, M_ = 2, 8, 4
+    kp = jax.random.split(jax.random.PRNGKey(5), 2)
+    pipe_params = {
+        "w": jax.random.normal(kp[0], (S_, D_, D_), jnp.float32) * 0.4,
+        "b": jnp.zeros((S_, D_), jnp.float32),
+    }
+    popt = FusedAdam(lr=1e-2)
+    pipe_opt = popt.init(pipe_params)
+
+    def mse(outputs, labels):
+        return jnp.mean((outputs - labels) ** 2)
+
+    step = make_spmd_pipeline_train_step(
+        stage_fn, mse, popt, num_stages=S_, micro_batches=M_,
+        mesh=pmesh, schedule="1f1b")
+    # batch rows shard over 'data' (2 shards x 4 rows)
+    xs = jax.random.normal(jax.random.PRNGKey(6), (M_, 8, D_), jnp.float32)
+    ys = jax.random.normal(jax.random.PRNGKey(7), (M_, 8, D_), jnp.float32)
+    with pmesh:
+        sp = jax.tree.map(
+            lambda a: jax.device_put(
+                a, NamedSharding(pmesh, P("pipe"))), pipe_params)
+        so = jax.tree.map(
+            lambda a: jax.device_put(a, NamedSharding(
+                pmesh, P("pipe") if a.ndim else P())), pipe_opt)
+        (_, _), pipe_loss = step(sp, so, xs, ys, jnp.float32(1e-2))
+    pipe_loss = float(jax.device_get(pipe_loss))
+
+    def seq_loss(p):
+        outs = []
+        for m in range(M_):
+            hcur = xs[m]
+            for s in range(S_):
+                hcur = stage_fn(jax.tree.map(lambda a: a[s], p), hcur)
+            outs.append(hcur)
+        return mse(jnp.stack(outs), ys)
+
+    ref_pipe = float(seq_loss(pipe_params))
+    assert abs(pipe_loss - ref_pipe) < 1e-5, (pipe_loss, ref_pipe)
+
+    if jax.process_index() == 0:
+        with open(result_file, "w") as f:
+            f.write("PARITY4-OK " + " ".join(f"{v:.6f}" for v in dist_losses)
+                    + f" pipe_loss={pipe_loss:.6f}")
+    print(f"rank{jax.process_index()}: 4-process legs ok", flush=True)
+
+
+if __name__ == "__main__":
+    main()

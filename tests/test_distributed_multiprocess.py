@@ -26,38 +26,43 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_launcher_train_parity(tmp_path):
-    result_file = tmp_path / "result.txt"
+def _run_launcher(procs, worker, result_file, timeout):
+    """Spawn `procs` local workers through the repo launcher (one CPU
+    device each) and return the completed subprocess."""
     world_info = base64.urlsafe_b64encode(
-        json.dumps({"localhost": [0, 1]}).encode()
+        json.dumps({"localhost": list(range(procs))}).encode()
     ).decode()
-
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     # one CPU device per process: drop the suite's 8-device forcing flag
     env.pop("XLA_FLAGS", None)
     env["PYTHONPATH"] = REPO
-    # silence the coordinator's distributed-service port clashes on reruns
-    port = _free_port()
-
     cmd = [
         sys.executable, "-m", "deeperspeed_tpu.launcher.launch",
         "--node_rank", "0",
         "--master_addr", "127.0.0.1",
-        "--master_port", str(port),
+        # fresh port per run: silences coordinator port clashes on reruns
+        "--master_port", str(_free_port()),
         "--world_info", world_info,
-        "--procs_per_node", "2",
-        os.path.join(REPO, "tests", "dist_worker.py"),
+        "--procs_per_node", str(procs),
+        os.path.join(REPO, "tests", worker),
         str(result_file),
     ]
     proc = subprocess.run(
-        cmd, env=env, cwd=REPO, capture_output=True, text=True, timeout=300
+        cmd, env=env, cwd=REPO, capture_output=True, text=True,
+        timeout=timeout,
     )
     assert proc.returncode == 0, (
         f"launcher rc={proc.returncode}\nstdout:\n{proc.stdout[-3000:]}\n"
         f"stderr:\n{proc.stderr[-3000:]}"
     )
     assert result_file.exists(), proc.stdout[-2000:] + proc.stderr[-2000:]
+    return proc
+
+
+def test_two_process_launcher_train_parity(tmp_path):
+    result_file = tmp_path / "result.txt"
+    _run_launcher(2, "dist_worker.py", result_file, timeout=300)
     content = result_file.read_text()
     assert content.startswith("PARITY-OK"), content
     # training actually made progress
@@ -66,3 +71,17 @@ def test_two_process_launcher_train_parity(tmp_path):
     # phase 2 proof: each rank held only a fraction of the master state
     frac = float(content.split("offload_local_frac=")[1])
     assert frac < 0.9, content
+
+
+def test_four_process_launcher_pp2dp2(tmp_path):
+    """4-process fan-out (VERDICT r3 item 10): dp=4 engine parity plus a
+    pp2 x dp2 SPMD pipeline whose ppermute and gradient pmean cross
+    process boundaries."""
+    result_file = tmp_path / "result4.txt"
+    _run_launcher(4, "dist_worker4.py", result_file, timeout=600)
+    content = result_file.read_text()
+    assert content.startswith("PARITY4-OK"), content
+    losses = [float(v) for v in content.split()[1:] if "=" not in v]
+    # parity with the single-device reference is the real assertion (made
+    # in-worker); here just require visible descent over the 8 steps
+    assert losses[-1] < losses[0] * 0.9, losses
