@@ -349,8 +349,10 @@ class _ChunkMeta:
 
 class StreamedOffloadEngine:
     """Single-controller streamed training engine for models whose Adam
-    state exceeds device memory. API: ``loss = engine.train_batch(tokens)``
-    with tokens (B, S+1) int32; ``engine.timings`` holds the per-phase
+    state exceeds device memory. API: ``loss = engine.train_batch(batch)``
+    — GPT family: batch is tokens (B, S+1) int32; BERT family: batch is
+    an ``(input_ids, labels)`` pair of (B, S) int32 (labels use the -100
+    unscored convention). ``engine.timings`` holds the per-phase
     step-time breakdown the scale demo reports (compute_s / d2h_s / h2d_s /
     host_opt_s buckets, attributed at the blocking points of the
     single-controller schedule)."""
@@ -372,9 +374,17 @@ class StreamedOffloadEngine:
             raise ValueError("host_state must be 'fp32' or 'bf16'")
         if scfg.swap_states not in ("all", "exp_avg_sq"):
             raise ValueError("swap_states must be 'all' or 'exp_avg_sq'")
-        if cfg.moe is not None:
+        from ...models.bert import BertConfig
+
+        self.family = "bert" if isinstance(cfg, BertConfig) else "gpt"
+        if self.family == "gpt" and cfg.moe is not None:
             raise NotImplementedError(
-                "StreamedOffloadEngine supports dense GPT models")
+                "StreamedOffloadEngine supports dense GPT and BERT models")
+        if self.family == "bert" and (cfg.attn_dropout or
+                                      cfg.hidden_dropout):
+            raise NotImplementedError(
+                "BERT streaming does not thread dropout rngs yet; set "
+                "attn_dropout=hidden_dropout=0")
         self.cfg = cfg
         self.scfg = scfg
         self.device = device or jax.devices()[0]
@@ -534,6 +544,11 @@ class StreamedOffloadEngine:
             for cname in chunks:
                 yield cname, templates[cname], chunks[cname]
             return
+        if self.family == "bert":
+            raise NotImplementedError(
+                "BERT streaming requires host_params (the fresh-init "
+                "streaming generator is GPT-geometry; BERT-class models "
+                "fit host RAM to init normally)")
         cfg = self.cfg
         D, F = cfg.d_model, cfg.ffn_dim
         G, V = self.scfg.group_layers, cfg.vocab_size
@@ -714,6 +729,8 @@ class StreamedOffloadEngine:
         return tuple(packed), tuple(scales)
 
     def _build_fns(self):
+        if self.family == "bert":
+            return self._build_fns_bert()
         cfg, scfg = self.cfg, self.scfg
         cdt = cfg.dtype
         block = scfg.wire_block
@@ -859,47 +876,181 @@ class StreamedOffloadEngine:
             packed, scales = self._quant_tree(d_gl, key, gl_meta, block)
             return packed, scales
 
-        def make_apply(cname):
-            meta = self._meta[cname]
+        self._fns = {
+            "embed": f_embed, "group": f_group, "head_bwd": f_head_bwd,
+            "group_bwd": f_group_bwd, "embed_bwd": f_embed_bwd,
+            "apply_g": self._make_apply_for("g0"),
+            "apply_globals": self._make_apply_for("globals"),
+        }
+
+    def _make_apply_for(self, cname):
+        meta = self._meta[cname]
+        block = self.scfg.wire_block
+        if meta.concat:
+            pb, poff, sc, soff = meta.wire_geometry(block)
+
+        def wire_delta(packed, scales, i):
             if meta.concat:
-                pb, poff, sc, soff = meta.wire_geometry(block)
+                pk = jax.lax.dynamic_slice_in_dim(
+                    packed, int(poff[i]), pb[i])
+                sl = jax.lax.dynamic_slice_in_dim(
+                    scales, int(soff[i]), sc[i])
+            else:
+                pk, sl = packed[i], scales[i]
+            return _dev_dequant(pk, sl, meta.sizes[i], meta.bits[i],
+                                block)
 
-            def wire_delta(packed, scales, i):
-                if meta.concat:
-                    pk = jax.lax.dynamic_slice_in_dim(
-                        packed, int(poff[i]), pb[i])
-                    sl = jax.lax.dynamic_slice_in_dim(
-                        scales, int(soff[i]), sc[i])
-                else:
-                    pk, sl = packed[i], scales[i]
-                return _dev_dequant(pk, sl, meta.sizes[i], meta.bits[i],
-                                    block)
+        if meta.quant_resident:
+            # quant chunks have NO apply kernel: the uplink bytes ARE
+            # the new device storage (train_batch device_puts them
+            # directly) — shadow == device bit-exact by construction,
+            # zero device arithmetic, zero TPU byte-relayout temps
+            return None
 
-            if meta.quant_resident:
-                # quant chunks have NO apply kernel: the uplink bytes ARE
-                # the new device storage (train_batch device_puts them
-                # directly) — shadow == device bit-exact by construction,
-                # zero device arithmetic, zero TPU byte-relayout temps
-                return None
+        @partial(jax.jit, donate_argnums=(0,))
+        def f_apply(tree, packed, scales):
+            leaves, treedef = jax.tree.flatten(tree)
+            out = []
+            for i, l in enumerate(leaves):
+                delta = wire_delta(packed, scales, i)
+                out.append(
+                    (l.astype(jnp.float32)
+                     + delta.reshape(l.shape)).astype(jnp.bfloat16))
+            return jax.tree.unflatten(treedef, out)
 
-            @partial(jax.jit, donate_argnums=(0,))
-            def f_apply(tree, packed, scales):
-                leaves, treedef = jax.tree.flatten(tree)
-                out = []
-                for i, l in enumerate(leaves):
-                    delta = wire_delta(packed, scales, i)
-                    out.append(
-                        (l.astype(jnp.float32)
-                         + delta.reshape(l.shape)).astype(jnp.bfloat16))
-                return jax.tree.unflatten(treedef, out)
+        return f_apply
 
-            return f_apply
+    def _build_fns_bert(self):
+        """BERT-family stage functions (VERDICT r3 item 5: the engine was
+        hardwired to GPT geometry). Same streaming contract as the GPT
+        set: embed -> per-group scan -> head loss+bwd -> reverse-group
+        vjp -> embed bwd merge; the chunker is already generic (globals =
+        embed + pooler + mlm, layer groups = stacked encoder slices)."""
+        from ...models import bert as bert_mod
+        from ...ops.transformer.transformer import _layer_norm
+
+        cfg, scfg = self.cfg, self.scfg
+        cdt = cfg.dtype
+        block = scfg.wire_block
+        layer_cfg = cfg.layer_config()
+        g_meta = self._meta["g0"]
+        gl_meta = self._meta["globals"]
+
+        def group_fwd(gp, x):
+            def body(carry, lp):
+                return bert_mod._transformer_forward(
+                    lp, carry, layer_cfg), None
+
+            step = body
+            if cfg.remat:
+                step = jax.checkpoint(step, prevent_cse=False)
+            x, _ = jax.lax.scan(step, x, gp)
+            return x
+
+        def embed_core(e, tokens):
+            x = jnp.take(e["word"].astype(cdt), tokens, axis=0)
+            x = x + e["pos"][: tokens.shape[1]].astype(cdt)
+            x = x + e["type"][0].astype(cdt)  # single-segment path
+            return _layer_norm(x, e["ln_w"].astype(cdt),
+                               e["ln_b"].astype(cdt), cfg.layernorm_eps)
+
+        def chunk_stats(gl, x_chunk, labels_chunk):
+            """(sum nll, valid count) for one sequence chunk — the MLM
+            analog of the GPT builder's chunk_nll (bert.py _chunk_nll):
+            the (B, chunk, V) fp32 logits exist per chunk only and are
+            rematerialized in the backward."""
+            m = gl["mlm"]
+            h = jax.nn.gelu(
+                x_chunk @ m["w"].astype(cdt) + m["b"].astype(cdt),
+                approximate=False)
+            h = _layer_norm(h, m["ln_w"], m["ln_b"], cfg.layernorm_eps)
+            logits = (h @ gl["embed"]["word"].astype(cdt).T
+                      + m["bias"].astype(cdt)).astype(jnp.float32)
+            valid = labels_chunk != -100
+            safe = jnp.where(valid, labels_chunk, 0)
+            lse = jax.scipy.special.logsumexp(logits, axis=-1)
+            tgt = jnp.take_along_axis(logits, safe[..., None],
+                                      axis=-1)[..., 0]
+            nll = jnp.where(valid, lse - tgt, 0.0)
+            return jnp.sum(nll), jnp.sum(valid)
+
+        def head_loss(gl, x, labels):
+            B, Sx, D = x.shape
+            chunk = gpt_mod.pick_ce_chunk(Sx, cfg.ce_chunk)
+            if chunk and Sx > chunk:
+                n = Sx // chunk
+                xs = jnp.moveaxis(x.reshape(B, n, chunk, D), 1, 0)
+                ls = jnp.moveaxis(labels.reshape(B, n, chunk), 1, 0)
+                ck = jax.checkpoint(chunk_stats, static_argnums=())
+
+                def body(acc, xt):
+                    nl, ct = ck(gl, *xt)
+                    return (acc[0] + nl, acc[1] + ct), None
+
+                (tot, cnt), _ = jax.lax.scan(
+                    body, (jnp.float32(0.0), jnp.int32(0)), (xs, ls))
+                return tot / jnp.maximum(cnt, 1)
+            tot, cnt = chunk_stats(gl, x, labels)
+            return tot / jnp.maximum(cnt, 1)
+
+        @jax.jit
+        def f_embed(gl, tokens):
+            gl = self._storage_to_tree(gl, "globals")
+            return embed_core(gl["embed"], tokens)
+
+        @jax.jit
+        def f_group(gp, x):
+            return group_fwd(self._storage_to_tree(gp, "g0"), x)
+
+        @jax.jit
+        def f_head_bwd(gl, x, labels):
+            gl = self._storage_to_tree(gl, "globals")
+            # tiny layernorm/bias leaves differentiate in fp32 (their
+            # grads come out full precision for free — same rationale as
+            # the GPT builder's final_ln upcast)
+            gl32 = dict(gl)
+            gl32["mlm"] = dict(gl["mlm"])
+            for k in ("ln_w", "ln_b", "bias"):
+                gl32["mlm"][k] = gl["mlm"][k].astype(jnp.float32)
+            emb32 = dict(gl["embed"])
+            for k in ("ln_w", "ln_b"):
+                emb32[k] = gl["embed"][k].astype(jnp.float32)
+            gl32["embed"] = emb32
+            loss, (d_gl, dx) = jax.value_and_grad(
+                head_loss, argnums=(0, 1))(gl32, x, labels)
+            return loss, d_gl, dx
+
+        @partial(jax.jit, donate_argnums=(1, 2))
+        def f_group_bwd(gp, x_in, dx, key):
+            gp = self._storage_to_tree(gp, "g0")
+            _, vjp = jax.vjp(group_fwd, gp, x_in)
+            d_gp, dx_in = vjp(dx)
+            packed, scales = self._quant_tree(d_gp, key, g_meta, block)
+            return dx_in, packed, scales
+
+        @partial(jax.jit, donate_argnums=(1, 2))
+        def f_embed_bwd(gl, dx0, d_gl_head, tokens, key):
+            """Embedding-path grads by vjp (BERT tables are host-RAM
+            scale, no 6.7B-class segment-sum tricks needed), merged into
+            the head grads (the word table is TIED to the MLM decoder)."""
+            gl_tree = self._storage_to_tree(gl, "globals")
+
+            _, vjp = jax.vjp(lambda e: embed_core(e, tokens),
+                             gl_tree["embed"])
+            (d_embed,) = vjp(dx0)
+            d_gl = dict(d_gl_head)
+            d_gl["embed"] = jax.tree.map(
+                lambda a, b: (a.astype(jnp.float32)
+                              + b.astype(jnp.float32)).astype(a.dtype),
+                d_gl_head["embed"], d_embed)
+            packed, scales = self._quant_tree(d_gl, key, gl_meta, block)
+            return packed, scales
 
         self._fns = {
             "embed": f_embed, "group": f_group, "head_bwd": f_head_bwd,
             "group_bwd": f_group_bwd, "embed_bwd": f_embed_bwd,
-            "apply_g": make_apply("g0"),
-            "apply_globals": make_apply("globals"),
+            "apply_g": self._make_apply_for("g0"),
+            "apply_globals": self._make_apply_for("globals"),
         }
 
     # ------------------------------------------------------------- #
@@ -1012,8 +1163,9 @@ class StreamedOffloadEngine:
     # the step
     # ------------------------------------------------------------- #
 
-    def train_batch(self, tokens: np.ndarray) -> float:
-        """tokens: (B, S+1) int32. Returns the scalar loss."""
+    def train_batch(self, tokens) -> float:
+        """GPT: tokens (B, S+1) int32. BERT: (input_ids, labels) pair of
+        (B, S) int32. Returns the scalar loss."""
         if not self._fns:
             self._build_fns()
         scfg = self.scfg
@@ -1025,13 +1177,26 @@ class StreamedOffloadEngine:
         key = jax.random.PRNGKey((scfg.seed << 20) ^ self.step_count)
         keys = jax.random.split(key, self.n_groups + 1)
 
-        tokens = np.asarray(tokens, np.int32)
-        if tokens.shape[1] != scfg.seq + 1:
-            raise ValueError(
-                f"tokens must be (B, seq+1)=(B, {scfg.seq + 1}), got "
-                f"{tokens.shape}")
-        inputs = jax.device_put(tokens[:, :-1], self.device)
-        targets = jax.device_put(tokens[:, 1:], self.device)
+        if self.family == "bert":
+            # batch = (input_ids, labels), each (B, S); labels use the
+            # -100 unscored convention
+            ids, labels = tokens
+            ids = np.asarray(ids, np.int32)
+            labels = np.asarray(labels, np.int32)
+            if ids.shape[1] != scfg.seq or labels.shape != ids.shape:
+                raise ValueError(
+                    f"bert batch must be (ids, labels) of (B, {scfg.seq}),"
+                    f" got {ids.shape} / {labels.shape}")
+            inputs = jax.device_put(ids, self.device)
+            targets = jax.device_put(labels, self.device)
+        else:
+            tokens = np.asarray(tokens, np.int32)
+            if tokens.shape[1] != scfg.seq + 1:
+                raise ValueError(
+                    f"tokens must be (B, seq+1)=(B, {scfg.seq + 1}), got "
+                    f"{tokens.shape}")
+            inputs = jax.device_put(tokens[:, :-1], self.device)
+            targets = jax.device_put(tokens[:, 1:], self.device)
 
         # ---- forward: stream groups, keep boundaries ---- #
         t0 = time.perf_counter()
