@@ -102,13 +102,20 @@ def is_static_available(q_bhsd) -> bool:
     if S > MAX_STATIC_SEQ or S < 8 or S % 8 or Dh % 8:
         return False
     itemsize = q_bhsd.dtype.itemsize if hasattr(q_bhsd.dtype, "itemsize") else 2
-    # resident working set per grid step (fwd): q,k,v,o input-dtype + one
-    # (bq, bk) fp32 score tile + (bq, Dh) fp32 acc; bwd: q,k,v,do resident
-    # + dq,dk,dv fp32 scratch + tiles. Budget 12MB of the 16MB VMEM with
-    # double-buffering headroom.
+    # Budget sized from the BACKWARD's worst-case working set (the most
+    # expensive kernel the gate admits — the auto dispatch would otherwise
+    # pass a geometry whose forward fits but whose backward Mosaic-fails at
+    # runtime): q,k,v,do inputs + dq,dk,dv outputs (input dtype), fp32
+    # dk/dv accumulators held as unrolled values, lse+delta rows, and the
+    # per-(qi,kj) fp32 tiles (s, p, dp, ds + pc + the dq accumulator).
+    # 12MB of the 16MB VMEM leaves double-buffering headroom.
     bq = _block_of(S)
-    resident = 4 * S * Dh * itemsize + 3 * S * Dh * 4
-    tiles = bq * bq * 4 * 2 + bq * Dh * 4
+    resident = (7 * S * Dh * itemsize      # q,k,v,do in + dq,dk,dv out
+                + 2 * S * Dh * 4           # dk_acc + dv_acc fp32 values
+                + 2 * S * 4)               # lse + delta rows
+    tiles = (4 * bq * bq * 4               # s, p, dp, ds fp32
+             + bq * bq * itemsize          # pc cast tile
+             + bq * Dh * 4)                # dq accumulator
     return resident + tiles <= 12 * 1024 * 1024
 
 

@@ -281,6 +281,11 @@ class StreamConfig:
     # 'all' (default) or 'exp_avg_sq' (v only — the 20B budget keeps
     # master+m in RAM and only v on disk)
     swap_states: str = "all"
+    # save_checkpoint prunes the previously-'latest' checkpoint ONLY when
+    # its tag is auto-generated (global_step*); user-named tags are always
+    # retained. False retains every auto save too (mind the disk: one
+    # 6.7B full save is ~90GB).
+    ckpt_prune_auto_tags: bool = True
 
 
 class _ChunkMeta:
@@ -424,10 +429,12 @@ class StreamedOffloadEngine:
             self._meta[cname] = meta
             if meta.quant_resident:
                 # quantized residency: shadow = per-leaf codes; the master
-                # keeps the FULL init precision (the quantization residual
-                # re-injects through the error-fed delta wire over steps —
-                # at int4 the residual is ~10% of weight scale, too much to
-                # discard the way the bf16 profile's sub-bf16 bits were)
+                # keeps the FULL init precision and stays authoritative —
+                # each uplink wholesale replaces the device codes with
+                # quant(master) (no delta wire, no error-feedback replay),
+                # so the quantization residual simply persists in the fp32
+                # master instead of being discarded the way the bf16
+                # profile's sub-bf16 bits were
                 self._shadow[cname] = self._quant_shadow_from_f32(
                     cname, meta, flat)
                 master = np.ascontiguousarray(flat, np.float32)
@@ -1313,7 +1320,14 @@ class StreamedOffloadEngine:
         plus step/rng under ``save_dir/<tag>/``, then point ``latest`` at
         it. One chunk is materialized at a time (an NVMe-tier 20B model's
         states never coexist in RAM); writes go to a tmp dir renamed into
-        place so a killed save never corrupts ``latest``."""
+        place so a killed save never corrupts ``latest``.
+
+        Retention: after a successful save, the previously-``latest``
+        checkpoint is deleted IF its tag was auto-generated
+        (``global_step*``) and ``StreamConfig.ckpt_prune_auto_tags`` is
+        True (the default — full saves are ~90GB at 6.7B and share the
+        disk with the NVMe state tier). User-supplied tags are never
+        pruned."""
         import json as _json
         import shutil
 
@@ -1364,10 +1378,15 @@ class StreamedOffloadEngine:
         os.replace(latest_path + ".tmp", latest_path)
         if old is not None:
             shutil.rmtree(old, ignore_errors=True)
-        # prune the previous checkpoint: at 6.7B each save is ~90GB and the
-        # NVMe tier shares the disk — unbounded retention would ENOSPC the
-        # run the feature exists to protect
-        if prev_latest and prev_latest != tag:
+        # prune the previously-'latest' AUTO-generated checkpoint: at 6.7B
+        # each save is ~90GB and the NVMe tier shares the disk — unbounded
+        # retention would ENOSPC the run the feature exists to protect.
+        # User-named tags are never pruned (saving tag='milestone2' must
+        # not destroy 'milestone1'); set ckpt_prune_auto_tags=False to
+        # retain every save.
+        if (self.scfg.ckpt_prune_auto_tags and prev_latest
+                and prev_latest != tag
+                and prev_latest.startswith("global_step")):
             stale = os.path.join(save_dir, prev_latest)
             if os.path.isdir(stale):
                 shutil.rmtree(stale, ignore_errors=True)

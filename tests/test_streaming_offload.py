@@ -401,6 +401,45 @@ def test_checkpoint_latest_and_geometry_guard(tmp_path):
     assert eng3.load_checkpoint(str(tmp_path / "empty")) is None
 
 
+def test_checkpoint_retention_user_tags_kept(tmp_path):
+    """ADVICE r4: pruning only eats auto-generated global_step* tags —
+    saving tag='milestone2' must not destroy 'milestone1', and
+    ckpt_prune_auto_tags=False retains every auto save."""
+    cfg = tiny_cfg()
+    scfg = StreamConfig(micro_batch=B, seq=S, wire_bits=8, warmup_steps=0)
+    eng, _ = make_engine(cfg, scfg)
+    data = batch(seed=7, n=4)
+
+    eng.train_batch(data[0])
+    eng.save_checkpoint(str(tmp_path), tag="milestone1")
+    eng.train_batch(data[1])
+    eng.save_checkpoint(str(tmp_path), tag="milestone2")
+    assert (tmp_path / "milestone1").is_dir()  # user tag survives
+    assert (tmp_path / "latest").read_text() == "milestone2"
+
+    # auto tags: the previous latest IS pruned (the default protects disk)
+    eng.train_batch(data[2])
+    eng.save_checkpoint(str(tmp_path))          # global_step3
+    eng.train_batch(data[3])
+    eng.save_checkpoint(str(tmp_path))          # global_step4
+    assert not (tmp_path / "global_step3").is_dir()
+    assert (tmp_path / "global_step4").is_dir()
+    # the user tags are still untouched
+    assert (tmp_path / "milestone1").is_dir()
+    assert (tmp_path / "milestone2").is_dir()
+
+    # retention off: both auto saves kept
+    scfg2 = StreamConfig(micro_batch=B, seq=S, wire_bits=8, warmup_steps=0,
+                         ckpt_prune_auto_tags=False)
+    eng2, _ = make_engine(cfg, scfg2)
+    eng2.train_batch(data[0])
+    eng2.save_checkpoint(str(tmp_path / "k2"))  # global_step1
+    eng2.train_batch(data[1])
+    eng2.save_checkpoint(str(tmp_path / "k2"))  # global_step2
+    assert (tmp_path / "k2" / "global_step1").is_dir()
+    assert (tmp_path / "k2" / "global_step2").is_dir()
+
+
 def test_checkpoint_resume_nvme_tier(tmp_path):
     """Resume with the swapper state tier: states round-trip through the
     NVMe files."""
