@@ -289,6 +289,219 @@ int stream_chunk_step_impl(int optimizer_id, int64_t step, float lr,
     return 0;
 }
 
+// ------------------------------------------------------------------ //
+// Generalized streamed chunk step (the 20B ZeRO-Infinity profile):
+//   - optimizer state stored as fp32 OR bf16 bits (host_state='bf16':
+//     master/exp_avg/exp_avg_sq are uint16 round-to-nearest-even images;
+//     fp32 transients exist only per wire block, never per chunk — the
+//     numpy path's 3x chunk-sized fp32 copies were both the 65min/step
+//     host_opt cost and the arena-fragmentation OOM at 20B);
+//   - uplink mode 0: error-fed delta against the bf16 shadow (identical
+//     semantics to ds_stream_chunk_step above);
+//   - uplink mode 1 (quant-resident): the uplink IS the new resident
+//     representation quant(master) — per-leaf res_bits 4/8 codes + fp32
+//     block scales, or bf16 bits for small (res_bits=16) leaves. No
+//     error feedback: the master is authoritative and the device stores
+//     the uplinked bytes verbatim (streaming._host_chunk_step contract).
+// Wire/resident blocking both use the same `block`, so one pass over a
+// leaf serves grad dequant, Adam, state writeback, and re-encode.
+// ------------------------------------------------------------------ //
+
+inline float sext4(int v) { return (float)(v >= 8 ? v - 16 : v); }
+
+// Dequantize `count` wire elements of block b (block-local fp32 out).
+// int4 is leaf-level HALF-SPLIT: element e rides byte e (low nibble) when
+// e < half, byte e-half (high nibble) otherwise; a block can straddle the
+// boundary, so the low/high runs are two separate (auto-vectorizable)
+// loops.
+inline void dequant_block(const unsigned char* gp, float gs, int64_t e0,
+                          int64_t count, int bits, int64_t half,
+                          float* out) {
+    if (bits == 8) {
+        for (int64_t j = 0; j < count; ++j)
+            out[j] = (float)(int8_t)gp[e0 + j] * gs;
+        return;
+    }
+    int64_t lo_n = half > e0 ? (half - e0 < count ? half - e0 : count) : 0;
+    for (int64_t j = 0; j < lo_n; ++j)
+        out[j] = sext4(gp[e0 + j] & 0x0F) * gs;
+    for (int64_t j = lo_n; j < count; ++j)
+        out[j] = sext4(gp[e0 + j - half] >> 4) * gs;
+}
+
+// Quantize `count` fp32 values into the wire/resident layout at block b.
+// Writes the scale, ORs code nibbles into memset-0 output (two blocks
+// share a byte across the half boundary), and optionally replays the
+// dequantized values back into `replay` (error-feedback shadow advance).
+inline float quant_block(const float* x, int64_t e0, int64_t count,
+                         int bits, int64_t half, unsigned char* op,
+                         float* scale_out, float* replay) {
+    const float qmax = bits == 4 ? 7.f : 127.f;
+    float absmax = 0.f;
+    for (int64_t j = 0; j < count; ++j) {
+        float a = fabsf(x[j]);
+        if (a > absmax) absmax = a;
+    }
+    const float s = absmax > 0.f ? absmax / qmax : 1.f;
+    *scale_out = s;
+    const float inv_s = 1.f / s;
+    if (bits == 8) {
+        for (int64_t j = 0; j < count; ++j) {
+            float q = nearbyintf(x[j] * inv_s);
+            if (q > qmax) q = qmax;
+            if (q < -qmax - 1) q = -qmax - 1;
+            op[e0 + j] = (unsigned char)(int8_t)(int)q;
+            if (replay) replay[j] = q * s;
+        }
+        return s;
+    }
+    int64_t lo_n = half > e0 ? (half - e0 < count ? half - e0 : count) : 0;
+    for (int64_t j = 0; j < count; ++j) {
+        float q = nearbyintf(x[j] * inv_s);
+        if (q > qmax) q = qmax;
+        if (q < -qmax - 1) q = -qmax - 1;
+        const int qi = (int)q;
+        if (j < lo_n)
+            op[e0 + j] |= (unsigned char)(qi & 0x0F);
+        else
+            op[e0 + j - half] |= (unsigned char)((qi & 0x0F) << 4);
+        if (replay) replay[j] = q * s;
+    }
+    return s;
+}
+
+int stream_chunk_step2_impl(
+    int optimizer_id, int64_t step, float lr, const unsigned char* g_packed,
+    const float* g_scales, void* master, void* exp_avg, void* exp_avg_sq,
+    int state_bf16, uint16_t* shadow, unsigned char* out_packed,
+    float* out_scales, unsigned char* out_c, float* out_s, uint16_t* out_w,
+    const int64_t* leaf_sizes, const int* leaf_bits, const int* res_bits,
+    int64_t n_leaves, int block, int mode) {
+    AdamConfig c;
+    {
+        std::lock_guard<std::mutex> g(g_mu);
+        auto it = g_optimizers.find(optimizer_id);
+        if (it == g_optimizers.end()) return -1;
+        c = it->second;
+    }
+    const float bc1 = c.bias_correction ? 1.f - powf(c.beta1, (float)step) : 1.f;
+    const float bc2_sqrt =
+        c.bias_correction ? sqrtf(1.f - powf(c.beta2, (float)step)) : 1.f;
+    const float step_size = lr / bc1;
+
+    // whole-wire validation up front (a mid-loop rejection would leave
+    // earlier leaves stepped; the caller would then numpy-fallback and
+    // double-apply)
+    for (int64_t li = 0; li < n_leaves; ++li) {
+        if (leaf_bits[li] != 4 && leaf_bits[li] != 8) return -2;
+        if (mode == 1 && res_bits[li] != 4 && res_bits[li] != 8 &&
+            res_bits[li] != 16)
+            return -2;
+    }
+
+    float* gbuf = new float[block];
+    float* pbuf = new float[block];
+    float* mbuf = new float[block];
+    float* vbuf = new float[block];
+    float* dbuf = new float[block];
+
+    int64_t elem_off = 0, g_byte_off = 0, g_scale_off = 0;
+    int64_t c_byte_off = 0, c_scale_off = 0, w_off = 0;
+    for (int64_t li = 0; li < n_leaves; ++li) {
+        const int64_t n = leaf_sizes[li];
+        const int bits = leaf_bits[li];
+        const int64_t nb = (n + block - 1) / block;
+        const int64_t padded = nb * block;
+        const int64_t half = padded / 2;
+        const int64_t g_leaf_bytes = bits == 4 ? padded / 2 : padded;
+        const unsigned char* gp = g_packed + g_byte_off;
+        const int rb = mode == 1 ? res_bits[li] : 16;
+        // uplink geometry for this leaf
+        unsigned char* up_codes = nullptr;
+        float* up_scales = nullptr;
+        int up_bits = 0;
+        if (mode == 0) {
+            up_codes = out_packed + g_byte_off;  // wire-shaped delta uplink
+            up_scales = out_scales + g_scale_off;
+            up_bits = bits;
+            memset(up_codes, 0, (size_t)g_leaf_bytes);
+        } else if (rb < 16) {
+            up_codes = out_c + c_byte_off;
+            up_scales = out_s + c_scale_off;
+            up_bits = rb;
+            memset(up_codes, 0, (size_t)(rb == 4 ? padded / 2 : padded));
+        }
+        for (int64_t b = 0; b < nb; ++b) {
+            const int64_t e0 = b * block;
+            const int64_t count = (e0 + block <= n) ? block : (n - e0);
+            if (count <= 0) {  // pure padding block: zero codes, unit scale
+                if (up_scales) up_scales[b] = 1.f;
+                continue;
+            }
+            dequant_block(gp, g_scales[g_scale_off + b], e0, count, bits,
+                          half, gbuf);
+            float *p, *m, *v;
+            if (state_bf16) {
+                uint16_t* pm = (uint16_t*)master + elem_off + e0;
+                uint16_t* mm = (uint16_t*)exp_avg + elem_off + e0;
+                uint16_t* vm = (uint16_t*)exp_avg_sq + elem_off + e0;
+                for (int64_t j = 0; j < count; ++j) pbuf[j] = bf16_to_f32(pm[j]);
+                for (int64_t j = 0; j < count; ++j) mbuf[j] = bf16_to_f32(mm[j]);
+                for (int64_t j = 0; j < count; ++j) vbuf[j] = bf16_to_f32(vm[j]);
+                p = pbuf; m = mbuf; v = vbuf;
+            } else {
+                p = (float*)master + elem_off + e0;
+                m = (float*)exp_avg + elem_off + e0;
+                v = (float*)exp_avg_sq + elem_off + e0;
+            }
+            adam_block(p, gbuf, m, v, count, c, step_size, bc2_sqrt, lr);
+            // uplink from the UNROUNDED fp32 update (the bf16 state store
+            // below rounds; streaming.py's numpy path quantizes the fp32
+            // transient before the writeback, so order matters for parity)
+            if (mode == 0) {
+                uint16_t* sh = shadow + elem_off + e0;
+                for (int64_t j = 0; j < count; ++j)
+                    dbuf[j] = p[j] - bf16_to_f32(sh[j]);
+                quant_block(dbuf, e0, count, up_bits, half, up_codes,
+                            up_scales + b, dbuf);
+                for (int64_t j = 0; j < count; ++j)
+                    sh[j] = f32_to_bf16(bf16_to_f32(sh[j]) + dbuf[j]);
+            } else if (rb < 16) {
+                quant_block(p, e0, count, up_bits, half, up_codes,
+                            up_scales + b, nullptr);
+            } else {
+                uint16_t* w = out_w + w_off + e0;
+                for (int64_t j = 0; j < count; ++j) w[j] = f32_to_bf16(p[j]);
+            }
+            if (state_bf16) {
+                uint16_t* pm = (uint16_t*)master + elem_off + e0;
+                uint16_t* mm = (uint16_t*)exp_avg + elem_off + e0;
+                uint16_t* vm = (uint16_t*)exp_avg_sq + elem_off + e0;
+                for (int64_t j = 0; j < count; ++j) pm[j] = f32_to_bf16(pbuf[j]);
+                for (int64_t j = 0; j < count; ++j) mm[j] = f32_to_bf16(mbuf[j]);
+                for (int64_t j = 0; j < count; ++j) vm[j] = f32_to_bf16(vbuf[j]);
+            }
+        }
+        elem_off += n;
+        g_byte_off += g_leaf_bytes;
+        g_scale_off += nb;
+        if (mode == 1) {
+            if (rb < 16) {
+                c_byte_off += rb == 4 ? padded / 2 : padded;
+                c_scale_off += nb;
+            } else {
+                w_off += n;
+            }
+        }
+    }
+    delete[] gbuf;
+    delete[] pbuf;
+    delete[] mbuf;
+    delete[] vbuf;
+    delete[] dbuf;
+    return 0;
+}
+
 }  // namespace
 
 extern "C" {
@@ -343,6 +556,30 @@ int ds_stream_chunk_step(int optimizer_id, long long step, float lr,
                                   (uint16_t*)shadow, out_packed, out_scales,
                                   (const int64_t*)leaf_sizes, leaf_bits,
                                   n_leaves, block);
+}
+
+// Generalized streamed chunk step. `state_bf16` selects uint16 bf16-bits
+// state buffers (the 20B host_state='bf16' profile) vs fp32; `mode` 0 is
+// the error-fed delta uplink against the bf16 `shadow` (out_packed/
+// out_scales in wire geometry), mode 1 the quant-resident uplink
+// (out_c/out_s/out_w in streaming._ChunkMeta.res_geometry layout;
+// `shadow` unused). Returns 0; -1 unknown optimizer id; -2 unsupported
+// leaf precisions (caller falls back to numpy).
+int ds_stream_chunk_step2(int optimizer_id, long long step, float lr,
+                          const unsigned char* g_packed,
+                          const float* g_scales, void* master,
+                          void* exp_avg, void* exp_avg_sq, int state_bf16,
+                          unsigned short* shadow, unsigned char* out_packed,
+                          float* out_scales, unsigned char* out_c,
+                          float* out_s, unsigned short* out_w,
+                          const long long* leaf_sizes, const int* leaf_bits,
+                          const int* res_bits, long long n_leaves, int block,
+                          int mode) {
+    return stream_chunk_step2_impl(
+        optimizer_id, step, lr, g_packed, g_scales, master, exp_avg,
+        exp_avg_sq, state_bf16, (uint16_t*)shadow, out_packed, out_scales,
+        out_c, out_s, (uint16_t*)out_w, (const int64_t*)leaf_sizes,
+        leaf_bits, res_bits, n_leaves, block, mode);
 }
 
 // Introspection for ds_report.

@@ -203,6 +203,54 @@ class DeepSpeedCPUAdam(FusedAdam):
             raise RuntimeError("native stream_chunk_step failed")
         return True
 
+    def step_stream_chunk2(self, step, g_packed, g_scales, master, exp_avg,
+                           exp_avg_sq, shadow_u16, out_packed, out_scales,
+                           out_c, out_s, out_w, leaf_sizes, leaf_bits,
+                           res_bits, block, mode, lr=None) -> bool:
+        """Generalized fused offload-wire step (csrc ds_stream_chunk_step2)
+        covering the 20B ZeRO-Infinity profiles the original entry cannot:
+        bf16-bits optimizer state (master/exp_avg/exp_avg_sq as uint16) and
+        quant-resident uplinks (mode=1: out_c/out_s/out_w carry the new
+        int4/int8 resident codes + bf16 small leaves; no shadow/delta).
+        mode=0 keeps the error-fed delta semantics of step_stream_chunk.
+        State dtype is inferred from ``master.dtype`` (uint16 -> bf16 bits;
+        all three states must match). Returns False when the native op is
+        unavailable or the leaf precisions are unsupported (caller falls
+        back to the numpy path)."""
+        if self._lib is None:
+            return False
+        import ctypes
+
+        import numpy as _np
+
+        lr = self.lr if lr is None else float(lr)
+        state_bf16 = master.dtype == _np.uint16
+        for a in (master, exp_avg, exp_avg_sq):
+            expect = _np.uint16 if state_bf16 else _np.float32
+            assert a.dtype == expect and a.flags["C_CONTIGUOUS"], (
+                a.dtype, expect)
+        ptr = lambda a, t: (a.ctypes.data_as(ctypes.POINTER(t))
+                            if a is not None else None)
+        vptr = lambda a: ctypes.c_void_p(a.ctypes.data)
+        sizes = _np.ascontiguousarray(leaf_sizes, _np.int64)
+        bits = _np.ascontiguousarray(leaf_bits, _np.int32)
+        rbits = _np.ascontiguousarray(res_bits, _np.int32)
+        rc = self._lib.ds_stream_chunk_step2(
+            self._opt_id, int(step), lr,
+            ptr(g_packed, ctypes.c_uint8), ptr(g_scales, ctypes.c_float),
+            vptr(master), vptr(exp_avg), vptr(exp_avg_sq), int(state_bf16),
+            ptr(shadow_u16, ctypes.c_uint16),
+            ptr(out_packed, ctypes.c_uint8), ptr(out_scales, ctypes.c_float),
+            ptr(out_c, ctypes.c_uint8), ptr(out_s, ctypes.c_float),
+            ptr(out_w, ctypes.c_uint16),
+            ptr(sizes, ctypes.c_longlong), ptr(bits, ctypes.c_int),
+            ptr(rbits, ctypes.c_int), len(sizes), int(block), int(mode))
+        if rc == -2:
+            return False
+        if rc != 0:
+            raise RuntimeError("native stream_chunk_step2 failed")
+        return True
+
     def step_flat(self, step, params, grads, exp_avg, exp_avg_sq, lr=None,
                   bf16_out=None):
         """In-place Adam step on flat fp32 numpy arrays. `bf16_out` (uint16

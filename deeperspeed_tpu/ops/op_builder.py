@@ -197,6 +197,17 @@ class CPUAdamBuilder(OpBuilder):
             c.POINTER(c.c_longlong), c.POINTER(c.c_int),  # leaf geometry
             c.c_longlong, c.c_int,        # n_leaves, block
         ]
+        lib.ds_stream_chunk_step2.restype = c.c_int
+        lib.ds_stream_chunk_step2.argtypes = [
+            c.c_int, c.c_longlong, c.c_float,
+            u8p, fp,                      # wire grads: packed + scales
+            c.c_void_p, c.c_void_p, c.c_void_p, c.c_int,  # state (+bf16 flag)
+            c.POINTER(c.c_uint16),        # bf16 shadow bits (mode 0)
+            u8p, fp,                      # mode-0 delta wire out
+            u8p, fp, c.POINTER(c.c_uint16),  # mode-1 resident out: c/s/w
+            c.POINTER(c.c_longlong), c.POINTER(c.c_int), c.POINTER(c.c_int),
+            c.c_longlong, c.c_int, c.c_int,  # n_leaves, block, mode
+        ]
 
 
 ALL_OPS = {b.NAME: b for b in (AsyncIOBuilder(), CPUAdamBuilder())}

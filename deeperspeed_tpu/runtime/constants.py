@@ -205,3 +205,11 @@ SPARSE_BLOCK = "block"
 SPARSE_BLOCK_DEFAULT = 16
 SPARSE_DIFFERENT_LAYOUT_PER_HEAD = "different_layout_per_head"
 SPARSE_DIFFERENT_LAYOUT_PER_HEAD_DEFAULT = False
+
+#############################################
+# Streaming ZeRO-Infinity executor (single-chip giant-model path):
+# explicit "streaming" config block; also auto-enabled by
+# zero_optimization.stage=3 + offload_param.device in (cpu, nvme)
+#############################################
+STREAMING = "streaming"
+STREAMING_ENABLED = "enabled"

@@ -1651,6 +1651,36 @@ def initialize(
 
     from .pipe.module import PipelineModule
 
+    # Streaming ZeRO-Infinity route (reference engine.py:803 one-flag
+    # stage-3/Infinity entry): a model *config* (GPTConfig/BertConfig)
+    # plus a config enabling streaming — an explicit "streaming" block or
+    # zero stage 3 with offload_param.device cpu/nvme — constructs the
+    # StreamedOffloadEngine (host-RAM/NVMe optimizer state, quantized
+    # offload wire, optionally quantized device residency).
+    from ..models.bert import BertConfig as _BertConfig
+    from ..models.gpt import GPTConfig as _GPTConfig
+
+    if isinstance(model, (_GPTConfig, _BertConfig)):
+        # streaming world = the dp extent (single-controller; one device
+        # unless a mesh with a data axis is given) — NOT jax.device_count,
+        # which would mis-derive the batch triple on multi-device hosts
+        world_size = (int(mesh.shape.get(DATA_AXIS, 1))
+                      if mesh is not None else 1)
+        ds_config = (config if isinstance(config, TrainingConfig)
+                     else TrainingConfig(config, world_size=world_size))
+        if not ds_config.streaming_enabled:
+            raise ValueError(
+                "initialize() got a model config (GPTConfig/BertConfig) "
+                "but the ds_config does not enable the streaming engine — "
+                'add a "streaming" block or zero stage 3 with '
+                "offload_param.device cpu/nvme, or pass a loss callable "
+                "instead of a model config")
+        from .offload.streaming import build_streamed_engine
+
+        engine = build_streamed_engine(
+            model, ds_config, host_params=model_parameters, mesh=mesh)
+        return engine, engine.opt, None, None
+
     if isinstance(model, PipelineModule):
         # reference __init__.py:52 builds a PipelineEngine for PipelineModule
         from .pipe.engine import PipelineEngine
