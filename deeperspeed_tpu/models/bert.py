@@ -299,6 +299,56 @@ def make_bert(cfg: BertConfig, mesh=None):
     return init_fn, apply_fn, mlm_loss_fn, param_specs(cfg)
 
 
+def make_bert_qa(cfg: BertConfig, mesh=None):
+    """SQuAD-class span-extraction fine-tuning (the reference's
+    BingBertSquad leg: tests/model/BingBertSquad + the 1.5x fine-tune
+    claim in docs/_posts/2020-05-28-fastest-bert-training.md:105-121).
+
+    Returns (init_fn, apply_fn, qa_loss_fn, specs). The QA head is the
+    standard 2-wide span projection; ``qa_loss_fn(params, batch, rng)``
+    takes batch = (input_ids, start_positions, end_positions[,
+    attention_mask]) and averages start/end cross-entropy, with the rng
+    threading dropout through every layer (fine-tuning runs the 0.1
+    dropout the MLM pretraining benches disable)."""
+    init_fn, apply_fn, _, specs = make_bert(cfg, mesh=mesh)
+
+    def qa_init_fn(rng):
+        k1, k2 = jax.random.split(rng)
+        params = init_fn(k1)
+        D = cfg.d_model
+        params["qa"] = {
+            "w": jax.random.normal(k2, (D, 2), jnp.float32)
+            * cfg.initializer_range,
+            "b": jnp.zeros((2,), jnp.float32),
+        }
+        return params
+
+    def qa_loss_fn(params, batch, rng=None):
+        input_ids, start_pos, end_pos = batch[0], batch[1], batch[2]
+        attention_mask = batch[3] if len(batch) > 3 else None
+        seq_out, _ = apply_fn(params, input_ids,
+                              attention_mask=attention_mask, rng=rng)
+        cdt = cfg.dtype
+        logits = (seq_out @ params["qa"]["w"].astype(cdt)
+                  + params["qa"]["b"].astype(cdt)).astype(jnp.float32)
+        if attention_mask is not None:
+            logits = jnp.where(attention_mask[..., None] > 0, logits, -1e9)
+
+        def span_nll(lg, pos):
+            lse = jax.scipy.special.logsumexp(lg, axis=-1)
+            tgt = jnp.take_along_axis(lg, pos[:, None], axis=-1)[:, 0]
+            return jnp.mean(lse - tgt)
+
+        return 0.5 * (span_nll(logits[..., 0], start_pos)
+                      + span_nll(logits[..., 1], end_pos))
+
+    qa_specs = dict(specs)
+    from jax.sharding import PartitionSpec as P
+
+    qa_specs["qa"] = {"w": P(), "b": P()}
+    return qa_init_fn, apply_fn, qa_loss_fn, qa_specs
+
+
 def params_from_hf(model, cfg: Optional[BertConfig] = None):
     """Import a huggingface BertModel/BertForMaskedLM checkpoint into the
     stacked param pytree (embeddings + all layers via module_inject)."""

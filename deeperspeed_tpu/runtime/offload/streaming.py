@@ -477,17 +477,25 @@ class StreamedOffloadEngine:
             raise ValueError("host_state must be 'fp32' or 'bf16'")
         if scfg.swap_states not in ("all", "exp_avg_sq"):
             raise ValueError("swap_states must be 'all' or 'exp_avg_sq'")
+        if scfg.ckpt_moment_bits not in (4, 8):
+            raise ValueError("ckpt_moment_bits must be 4 or 8 (other "
+                             "values silently corrupt the nibble packing)")
+        if scfg.ckpt_master_residual_bits not in (0, 4, 8):
+            raise ValueError("ckpt_master_residual_bits must be 0, 4 or 8")
         from ...models.bert import BertConfig
 
         self.family = "bert" if isinstance(cfg, BertConfig) else "gpt"
         if self.family == "gpt" and cfg.moe is not None:
             raise NotImplementedError(
                 "StreamedOffloadEngine supports dense GPT and BERT models")
-        if self.family == "bert" and (cfg.attn_dropout or
-                                      cfg.hidden_dropout):
-            raise NotImplementedError(
-                "BERT streaming does not thread dropout rngs yet; set "
-                "attn_dropout=hidden_dropout=0")
+        # dropout rngs thread through the BERT stage fns (fine-tune runs
+        # the 0.1 dropout pretraining benches disable). The SAME per-step
+        # per-group key feeds both the forward pass and the backward's
+        # vjp recompute, so the recomputed activations are identical —
+        # the correctness invariant the r4 guard existed to protect.
+        self._bert_dropout = (self.family == "bert"
+                              and bool(cfg.attn_dropout
+                                       or cfg.hidden_dropout))
         self.cfg = cfg
         self.scfg = scfg
         # dp composition: with a mesh carrying a 'data' axis of size dp>1,
@@ -1105,16 +1113,30 @@ class StreamedOffloadEngine:
         g_meta = self._meta["g0"]
         gl_meta = self._meta["globals"]
 
-        def group_fwd(gp, x):
-            def body(carry, lp):
+        dropout = self._bert_dropout
+        drop_base = jax.random.PRNGKey(scfg.seed ^ 0x5EED)
+
+        def group_fwd(gp, x, drop_key=None):
+            G = jax.tree.leaves(gp)[0].shape[0]
+
+            def body(carry, xs):
+                lp, i = xs
+                rng = (None if drop_key is None
+                       else jax.random.fold_in(drop_key, i))
                 return bert_mod._transformer_forward(
-                    lp, carry, layer_cfg), None
+                    lp, carry, layer_cfg, rng=rng), None
 
             step = body
             if cfg.remat:
                 step = jax.checkpoint(step, prevent_cse=False)
-            x, _ = jax.lax.scan(step, x, gp)
+            x, _ = jax.lax.scan(step, x, (gp, jnp.arange(G)))
             return x
+
+        def drop_key_for(step_no, gidx):
+            """Per-(step, group) dropout key from traced scalars — ONE
+            compiled f_group serves every group and step."""
+            return jax.random.fold_in(
+                jax.random.fold_in(drop_base, step_no), gidx)
 
         def embed_core(e, tokens):
             x = jnp.take(e["word"].astype(cdt), tokens, axis=0)
@@ -1167,9 +1189,15 @@ class StreamedOffloadEngine:
             gl = self._storage_to_tree(gl, "globals")
             return embed_core(gl["embed"], tokens)
 
-        @jax.jit
-        def f_group(gp, x):
-            return group_fwd(self._storage_to_tree(gp, "g0"), x)
+        if dropout:
+            @jax.jit
+            def f_group(gp, x, step_no, gidx):
+                return group_fwd(self._storage_to_tree(gp, "g0"), x,
+                                 drop_key_for(step_no, gidx))
+        else:
+            @jax.jit
+            def f_group(gp, x):
+                return group_fwd(self._storage_to_tree(gp, "g0"), x)
 
         @jax.jit
         def f_head_bwd(gl, x, labels):
@@ -1189,13 +1217,24 @@ class StreamedOffloadEngine:
                 head_loss, argnums=(0, 1))(gl32, x, labels)
             return loss, d_gl, dx
 
-        @partial(jax.jit, donate_argnums=(1, 2))
-        def f_group_bwd(gp, x_in, dx, key):
-            gp = self._storage_to_tree(gp, "g0")
-            _, vjp = jax.vjp(group_fwd, gp, x_in)
-            d_gp, dx_in = vjp(dx)
-            packed, scales = self._quant_tree(d_gp, key, g_meta, block)
-            return dx_in, packed, scales
+        if dropout:
+            @partial(jax.jit, donate_argnums=(1, 2))
+            def f_group_bwd(gp, x_in, dx, key, step_no, gidx):
+                gp = self._storage_to_tree(gp, "g0")
+                dk = drop_key_for(step_no, gidx)  # == the forward's key
+                _, vjp = jax.vjp(lambda g, x: group_fwd(g, x, dk),
+                                 gp, x_in)
+                d_gp, dx_in = vjp(dx)
+                packed, scales = self._quant_tree(d_gp, key, g_meta, block)
+                return dx_in, packed, scales
+        else:
+            @partial(jax.jit, donate_argnums=(1, 2))
+            def f_group_bwd(gp, x_in, dx, key):
+                gp = self._storage_to_tree(gp, "g0")
+                _, vjp = jax.vjp(group_fwd, gp, x_in)
+                d_gp, dx_in = vjp(dx)
+                packed, scales = self._quant_tree(d_gp, key, g_meta, block)
+                return dx_in, packed, scales
 
         @partial(jax.jit, donate_argnums=(1, 2))
         def f_embed_bwd(gl, dx0, d_gl_head, tokens, key):
@@ -1409,11 +1448,16 @@ class StreamedOffloadEngine:
             targets = jax.device_put(tokens[:, 1:], self._batch_sharding)
 
         # ---- forward: stream groups, keep boundaries ---- #
+        # dropout-active BERT: per-(step, group) args so the backward's
+        # vjp recompute derives the identical key as this forward
+        step_no = jnp.uint32(self.step_count)
+        dargs = (lambda g: ((step_no, jnp.uint32(g))
+                            if self._bert_dropout else ()))
         t0 = time.perf_counter()
         x = fns["embed"](self._dev_globals, inputs)
         boundaries = [x]
         for g in range(self.n_groups):
-            x = fns["group"](self._dev_groups[g], x)
+            x = fns["group"](self._dev_groups[g], x, *dargs(g))
             boundaries.append(x)
         loss, d_gl_head, dx = fns["head_bwd"](
             self._dev_globals, boundaries[-1], targets)
@@ -1426,7 +1470,7 @@ class StreamedOffloadEngine:
             t0 = time.perf_counter()
             x_in = boundaries.pop()  # group g's input; donated to its vjp
             dx, packed, scales = fns["group_bwd"](
-                self._dev_groups[g], x_in, dx, keys[g])
+                self._dev_groups[g], x_in, dx, keys[g], *dargs(g))
             jax.block_until_ready(packed)
             t["compute_s"] += time.perf_counter() - t0
 
@@ -1819,6 +1863,12 @@ def stream_config_from_ds_config(ds_config, model_cfg) -> StreamConfig:
         raise ValueError(
             f"streaming supports only WarmupLR (linear warmup to the "
             f"optimizer lr), got scheduler {ds_config.scheduler_name!r}")
+    if ds_config.optimizer_name not in (None, "Adam", "AdamW"):
+        raise ValueError(
+            f"the streaming engine's host pass is Adam; optimizer type "
+            f"{ds_config.optimizer_name!r} would silently train with "
+            f"different update math — use Adam/AdamW (1-bit optimizers "
+            f"ride the SPMD wire path, runtime/comm/onebit_spmd.py)")
 
     kw: Dict[str, Any] = {}
     kw["micro_batch"] = int(ds_config.train_micro_batch_size_per_gpu or 1)
@@ -1836,6 +1886,23 @@ def stream_config_from_ds_config(ds_config, model_cfg) -> StreamConfig:
     sch_p = ds_config.scheduler_params or {}
     if "warmup_num_steps" in sch_p:
         kw["warmup_steps"] = int(sch_p["warmup_num_steps"])
+    # WarmupLR semantics: the engine warms 0 -> lr linearly. A declared
+    # warmup_max_lr IS the peak lr (consume it); a nonzero warmup_min_lr
+    # or a warmup_max_lr conflicting with an explicit optimizer lr would
+    # train differently than declared — reject, per this function's
+    # policy on unimplemented semantics.
+    if float(sch_p.get("warmup_min_lr", 0.0) or 0.0) != 0.0:
+        raise ValueError(
+            "streaming's warmup ramps from 0; nonzero warmup_min_lr is "
+            "not supported")
+    if "warmup_max_lr" in sch_p:
+        wmax = float(sch_p["warmup_max_lr"])
+        if "lr" in kw and abs(wmax - kw["lr"]) > 1e-12:
+            raise ValueError(
+                f"warmup_max_lr={wmax} conflicts with optimizer "
+                f"lr={kw['lr']}; set them equal (the engine warms to one "
+                f"peak lr)")
+        kw["lr"] = wmax
     zc = ds_config.zero_config
     off_opt = zc.offload_optimizer
     if off_opt.enabled and off_opt.device == "nvme":

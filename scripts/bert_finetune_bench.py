@@ -1,0 +1,121 @@
+"""SQuAD-class BERT-large fine-tune throughput (VERDICT r4 item 8).
+
+The reference's fine-tune claim (docs/_posts/2020-05-28-fastest-bert-
+training.md:105-121): 50.76 samples/s at micro-batch 4 on a 16GB V100
+(1.4x PyTorch), 63.01 at micro-batch 32 on 32GB. This measures the same
+leg on the chip: BERT-large, S=384 (the SQuAD geometry), span head,
+dropout 0.1 ACTIVE (fine-tuning runs the dropout the MLM benches
+disable), ZeRO-2 masterless bf16 through the engine.
+
+Usage: python scripts/bert_finetune_bench.py [--micro 4 32] [--steps 8]
+Appends a "bert_squad_finetune" section into BENCH_EXTRA.json.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from bert_sparse_bench import peak_tflops  # noqa: E402
+
+
+def bench_finetune(seq: int, micro: int, steps: int, warmup: int = 2):
+    import deeperspeed_tpu as ds
+    from deeperspeed_tpu.models.bert import BertConfig, make_bert_qa
+
+    cfg = BertConfig(
+        vocab_size=30528, n_layer=24, n_head=16, d_model=1024, max_seq=seq,
+        dtype=jnp.bfloat16, remat=True, ce_chunk=0,
+        hidden_dropout=0.1, attn_dropout=0.1,
+    )
+    init_fn, _, qa_loss_fn, _ = make_bert_qa(cfg)
+    params = init_fn(jax.random.PRNGKey(0))
+    n_params = sum(p.size for p in jax.tree.leaves(params))
+    embed = sum(p.size for p in jax.tree.leaves(params["embed"]))
+    n_matmul = n_params - embed
+
+    engine, _, _, _ = ds.initialize(
+        model=qa_loss_fn, model_parameters=params,
+        config={
+            "train_micro_batch_size_per_gpu": micro,
+            "gradient_accumulation_steps": 1,
+            "optimizer": {"type": "Adam",
+                          "params": {"lr": 3e-5, "betas": [0.9, 0.999]}},
+            "bf16": {"enabled": True, "master_weights": False},
+            "zero_optimization": {"stage": 2},
+            "gradient_clipping": 1.0,
+            "steps_per_print": 10**9,
+        },
+        rng=jax.random.PRNGKey(11),
+    )
+    del params
+    r = np.random.default_rng(0)
+    ids = r.integers(0, 30000, size=(micro, seq), dtype=np.int32)
+    start = r.integers(0, seq, size=(micro,), dtype=np.int32)
+    end = r.integers(0, seq, size=(micro,), dtype=np.int32)
+    mask = np.ones((micro, seq), np.int32)
+    batch = (ids, start, end, mask)
+    for _ in range(warmup):
+        float(jax.device_get(engine.train_batch(batch)))
+    dts = []
+    for _ in range(2):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = engine.train_batch(batch)
+        float(jax.device_get(loss))
+        dts.append((time.perf_counter() - t0) / steps)
+    dt = min(dts)
+    samples_per_sec = micro / dt
+    flops_per_token = 6.0 * n_matmul + 12.0 * cfg.n_layer * cfg.d_model * seq
+    tflops = samples_per_sec * seq * flops_per_token / 1e12
+    return {
+        "seq": seq, "micro_batch": micro, "n_params": n_params,
+        "dropout": 0.1, "head": "squad_span",
+        "samples_per_sec": round(samples_per_sec, 2),
+        "step_time_s": round(dt, 4),
+        "tflops_per_chip": round(tflops, 1),
+        "mfu": round(tflops / peak_tflops(), 4),
+        "reference_v100": {"4": "50.76 samples/s (16GB, 1.4x torch)",
+                           "32": "63.01 samples/s (32GB)"}.get(
+            str(micro), "n/a"),
+        "precision": "masterless-bf16 + ZeRO-2, dropout active",
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--micro", type=int, nargs="+", default=[4, 32])
+    ap.add_argument("--seq", type=int, default=384)
+    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(__file__), "..", "BENCH_EXTRA.json"))
+    args = ap.parse_args()
+
+    rows = []
+    for mb in args.micro:
+        r = bench_finetune(args.seq, mb, args.steps)
+        print(json.dumps(r), flush=True)
+        rows.append(r)
+    try:
+        with open(args.out) as f:
+            extra = json.load(f)
+    except FileNotFoundError:
+        extra = {}
+    extra["bert_squad_finetune"] = rows
+    with open(args.out, "w") as f:
+        json.dump(extra, f, indent=1)
+    print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()

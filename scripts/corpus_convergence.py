@@ -1,23 +1,35 @@
-"""Real-corpus convergence gate (VERDICT r3 item 7).
+"""Real-corpus convergence gate (VERDICT r3 item 7; r4 item 6 fixes).
 
-Trains a GPT-125M-class model for >=1000 steps on the VENDORED real-language
-corpus (data/corpus_tokens.npy — natural English harvested in-image and
+Trains a GPT-125M-class model on the VENDORED real-language corpus
+(data/corpus_tokens.npy — natural English harvested in-image and
 BPE-tokenized by scripts/build_corpus.py) under the optimizer/partitioning
 configs the framework claims are loss-equivalent:
 
   zero0 (bf16 + fp32 master), zero1, zero2, masterless-bf16
 
-and compares full loss curves, the reference's model-gate methodology
-(/root/reference/tests/model/Megatron_GPT2/run_func_test.py:20-39: train
-the same model under config A and B on a real corpus, compare LM-loss
-curves within a tolerance). Unlike the synthetic gates, real text
-exercises Zipf-distributed embedding-row gradients, natural sequence
-correlation, and non-stationary batch statistics.
+comparing full loss curves — the reference's model-gate methodology
+(/root/reference/tests/model/Megatron_GPT2/run_func_test.py:20-39).
 
-Writes CONVERGENCE_CORPUS.json. Runs on whatever platform JAX provides;
-the artifact records it (the chip run is the gate).
+Round-5 honesty fixes (VERDICT r4 weak #4):
+  - the artifact records the DATA-PARALLEL EXTENT each leg ran at; with
+    dp=1 (the single chip) zero0/1/2 compile to the same program, so
+    identical curves demonstrate determinism, NOT sharded-layout parity.
+    The parity claim `zero_parity_ok` is only emitted by legs with dp>1
+    (the 8-device CPU mesh, where the stages actually shard); dp=1 runs
+    emit `identical_program_determinism_ok` instead.
+  - ~5% of corpus windows are HELD OUT; each leg reports eval loss and
+    perplexity on them (generalization, not just training-loss descent).
 
-Usage: python scripts/corpus_convergence.py [--steps 1000] [--micro 8]
+Sections accumulate in CONVERGENCE_CORPUS.json keyed by platform+dp, so
+the chip run (masterless/precision evidence) and the CPU-mesh run
+(sharded parity evidence) coexist.
+
+Usage:
+  python scripts/corpus_convergence.py --steps 1000            # chip
+  env -u PYTHONPATH JAX_PLATFORMS=cpu \
+      XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      PYTHONPATH=/root/repo python scripts/corpus_convergence.py \
+      --steps 150 --configs zero0,zero1,zero2                  # CPU mesh
 """
 
 import argparse
@@ -47,6 +59,8 @@ def main():
     ap.add_argument("--steps", type=int, default=1000)
     ap.add_argument("--micro", type=int, default=8)
     ap.add_argument("--seq", type=int, default=512)
+    ap.add_argument("--eval-frac", type=float, default=0.05)
+    ap.add_argument("--eval-batches", type=int, default=8)
     ap.add_argument("--configs", default="zero0,zero1,zero2,masterless")
     ap.add_argument("--out",
                     default=os.path.join(REPO, "CONVERGENCE_CORPUS.json"))
@@ -66,34 +80,56 @@ def main():
                     max_seq=args.seq, remat=False, ce_chunk=0)
     init_fn, _, loss_fn, _ = make_gpt(cfg)
 
-    def batches(steps, micro, seq):
+    seq = args.seq
+    n_win = tokens.size // (seq + 1)
+    n_eval = max(args.micro, int(n_win * args.eval_frac))
+    # held-out split: a FIXED tail slice of windows (deterministic across
+    # legs and rounds), never seen by the training shuffle
+    train_win = np.arange(n_win - n_eval)
+    eval_win = np.arange(n_win - n_eval, n_win)
+
+    def window(w):
+        return tokens[w * (seq + 1):(w + 1) * (seq + 1)]
+
+    def batches(steps, micro):
         """Contiguous windows, epoch-shuffled — real document order inside
         each sample (synthetic gates lack exactly this)."""
         r = np.random.default_rng(0)
-        n_win = tokens.size // (seq + 1)
-        order = r.permutation(n_win)
+        order = r.permutation(train_win)
         idx = 0
         for _ in range(steps):
-            rows = []
-            for _ in range(micro):
-                w = order[idx % n_win]
-                idx += 1
-                rows.append(tokens[w * (seq + 1):(w + 1) * (seq + 1)])
+            rows = [window(order[(idx + j) % train_win.size])
+                    for j in range(micro)]
+            idx += micro
             yield np.stack(rows).astype(np.int32)
 
-    out = {"steps": args.steps, "micro": args.micro, "seq": args.seq,
-           "corpus_tokens": int(tokens.size), "vocab": vocab,
-           "platform": jax.devices()[0].platform,
-           "device": str(jax.devices()[0].device_kind),
-           "losses_every_20": {}, "first_loss": {}, "tail_mean": {},
-           "seconds": {}}
+    r_ev = np.random.default_rng(1)
+    eval_sets = [
+        np.stack([window(w) for w in
+                  r_ev.choice(eval_win, size=args.micro, replace=False)]
+                 ).astype(np.int32)
+        for _ in range(args.eval_batches)]
+
+    eval_loss_fn = jax.jit(loss_fn)
+
+    dp = len(jax.devices())
+    platform = jax.devices()[0].platform
+    section_key = f"{platform}_dp{dp}"
+    section = {
+        "steps": args.steps, "micro": args.micro, "seq": seq,
+        "corpus_tokens": int(tokens.size), "vocab": vocab,
+        "platform": platform, "dp": dp,
+        "device": str(jax.devices()[0].device_kind),
+        "heldout_windows": int(n_eval),
+        "losses_every_20": {}, "first_loss": {}, "tail_mean": {},
+        "eval_loss": {}, "eval_ppl": {}, "seconds": {}}
     for name in args.configs.split(","):
         name = name.strip()
         params = init_fn(jax.random.PRNGKey(0))
         engine, _, _, _ = ds.initialize(
             model=loss_fn, model_parameters=params,
             config={
-                "train_micro_batch_size_per_gpu": args.micro,
+                "train_micro_batch_size_per_gpu": args.micro // dp,
                 "gradient_accumulation_steps": 1,
                 "optimizer": {"type": "Adam",
                               "params": {"lr": 6e-4,
@@ -108,37 +144,63 @@ def main():
         del params
         losses = []
         t0 = time.perf_counter()
-        for i, batch in enumerate(batches(args.steps, args.micro, args.seq)):
+        for i, batch in enumerate(batches(args.steps, args.micro)):
             loss = engine.train_batch(batch)
             if i % 20 == 0:
                 losses.append(round(float(jax.device_get(loss)), 4))
         losses.append(round(float(jax.device_get(loss)), 4))
         dt = time.perf_counter() - t0
-        out["losses_every_20"][name] = losses
-        out["first_loss"][name] = losses[0]
-        out["tail_mean"][name] = round(
-            float(np.mean(losses[-5:])), 4)
-        out["seconds"][name] = round(dt, 1)
-        print(f"{name}: first {losses[0]} tail {out['tail_mean'][name]} "
-              f"({dt:.0f}s)", flush=True)
+        ev = float(np.mean([
+            float(jax.device_get(eval_loss_fn(engine.state.params, b)))
+            for b in eval_sets]))
+        section["losses_every_20"][name] = losses
+        section["first_loss"][name] = losses[0]
+        section["tail_mean"][name] = round(float(np.mean(losses[-5:])), 4)
+        section["eval_loss"][name] = round(ev, 4)
+        section["eval_ppl"][name] = round(float(np.exp(ev)), 2)
+        section["seconds"][name] = round(dt, 1)
+        print(f"{name}: first {losses[0]} tail "
+              f"{section['tail_mean'][name]} eval {ev:.4f} "
+              f"(ppl {section['eval_ppl'][name]}) ({dt:.0f}s)", flush=True)
         del engine
 
-    tails = out["tail_mean"]
+    tails = section["tail_mean"]
     base = tails.get("zero0")
     if base is not None:
-        # zero1/2 must match zero0 closely (same math, different layout);
-        # masterless is a different numeric mode — wider tolerance, and
-        # the curve must still reach real-language perplexity territory
-        out["zero_parity_ok"] = all(
-            abs(tails[k] - base) < 0.05 * abs(base)
-            for k in ("zero1", "zero2") if k in tails)
+        stage_legs = [k for k in ("zero1", "zero2") if k in tails]
+        close = all(abs(tails[k] - base) < 0.05 * abs(base)
+                    for k in stage_legs)
+        if dp > 1 and stage_legs:
+            # stages genuinely shard at dp>1: this IS layout parity
+            section["zero_parity_ok"] = close
+        elif stage_legs:
+            # dp=1 compiles all stages to the same program — identical
+            # curves show determinism only (VERDICT r4 weak #4)
+            section["identical_program_determinism_ok"] = close
         if "masterless" in tails:
-            out["masterless_close"] = bool(
+            section["masterless_close"] = bool(
                 abs(tails["masterless"] - base) < 0.15 * abs(base))
+    try:
+        with open(args.out) as f:
+            out = json.load(f)
+        if "sections" not in out:
+            out = {"sections": {}, "note_r4_artifact": out}
+    except FileNotFoundError:
+        out = {"sections": {}}
+    out["sections"][section_key] = section
+    out["note"] = (
+        "sections keyed by platform+dp. dp=1 (single chip) legs cannot "
+        "demonstrate sharded-layout parity (stages compile identically); "
+        "their stage-leg agreement is labeled "
+        "identical_program_determinism_ok. zero_parity_ok comes from "
+        "dp>1 legs where ZeRO states actually shard. eval_loss/eval_ppl "
+        "are on a held-out 5% window split of the real corpus.")
     with open(args.out, "w") as f:
         json.dump(out, f, indent=1)
-    print(json.dumps({k: out[k] for k in
-                      ("tail_mean", "zero_parity_ok") if k in out}))
+    print(json.dumps({k: section[k] for k in
+                      ("tail_mean", "eval_ppl", "zero_parity_ok",
+                       "identical_program_determinism_ok")
+                      if k in section}))
 
 
 if __name__ == "__main__":

@@ -251,3 +251,45 @@ def test_mlm_gather_frac_real_cut_and_drop():
     l_over = float(loss_g(params, (ids, jnp.asarray(labels_over.reshape(B, S)))))
     l_kept = float(loss_full(params, (ids, jnp.asarray(labels_kept.reshape(B, S)))))
     np.testing.assert_allclose(l_over, l_kept, rtol=1e-6)
+
+
+def test_bert_qa_finetune_through_engine():
+    """SQuAD-class span fine-tune leg (VERDICT r4 item 8 / reference
+    BingBertSquad): QA head + dropout-active training through the engine
+    descends on a fixed batch, and dropout actually fires (two rngs give
+    different losses at the same params)."""
+    from deeperspeed_tpu.models.bert import make_bert_qa
+
+    cfg = _small_cfg(hidden_dropout=0.1, attn_dropout=0.1, remat=True)
+    init_fn, _, qa_loss_fn, _ = make_bert_qa(cfg)
+    params = init_fn(jax.random.PRNGKey(0))
+    assert "qa" in params and params["qa"]["w"].shape == (32, 2)
+
+    r = np.random.RandomState(0)
+    ids = jnp.asarray(r.randint(0, 128, (8, 16)))
+    start = jnp.asarray(r.randint(0, 16, (8,)))
+    end = jnp.asarray(r.randint(0, 16, (8,)))
+    mask = jnp.ones((8, 16), jnp.int32)
+    batch = (ids, start, end, mask)
+
+    l1 = qa_loss_fn(params, batch, rng=jax.random.PRNGKey(1))
+    l2 = qa_loss_fn(params, batch, rng=jax.random.PRNGKey(2))
+    assert np.isfinite(l1) and np.isfinite(l2)
+    assert abs(float(l1) - float(l2)) > 1e-6  # dropout is live
+
+    engine, _, _, _ = deepspeed.initialize(
+        model=qa_loss_fn, model_parameters=params,
+        config={
+            "train_micro_batch_size_per_gpu": 1,
+            "gradient_accumulation_steps": 1,
+            "optimizer": {"type": "Adam", "params": {"lr": 5e-3}},
+            "bf16": {"enabled": True},
+            "zero_optimization": {"stage": 2},
+            "steps_per_print": 10**9,
+        },
+        rng=jax.random.PRNGKey(7),
+    )
+    losses = [float(jax.device_get(engine.train_batch(batch)))
+              for _ in range(12)]
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[-3:]) < losses[0] - 0.5, losses

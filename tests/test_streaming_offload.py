@@ -829,11 +829,39 @@ def test_bert_streamed_chunked_ce_matches_fused():
     assert abs(losses[0] - losses[8]) < 1e-4, losses
 
 
-def test_bert_dropout_unsupported_raises():
-    cfg = _bert_cfg(hidden_dropout=0.1)
-    scfg = StreamConfig(micro_batch=B, seq=S, wire_bits=8)
-    with pytest.raises(NotImplementedError, match="dropout"):
-        StreamedOffloadEngine(cfg, scfg, host_params=None)
+def test_bert_streamed_dropout(monkeypatch):
+    """VERDICT r4 item 8: dropout rngs thread through streaming BERT (the
+    r4 guard is gone). Invariants: (a) dropout is LIVE — the same fixed
+    batch gives different losses on consecutive steps (per-step keys);
+    (b) the schedule is DETERMINISTIC — two engines with the same seed
+    produce identical loss sequences (the backward's vjp recompute must
+    re-derive the forward's exact masks, or grads would be garbage and
+    (c) the fixed batch would not descend)."""
+    monkeypatch.setattr(streaming, "MIN_QUANT_SIZE", 0)
+    from deeperspeed_tpu.models import bert as bert_mod
+
+    cfg = _bert_cfg(hidden_dropout=0.1, attn_dropout=0.1)
+    scfg = StreamConfig(micro_batch=B, seq=S, group_layers=2, wire_bits=8,
+                        warmup_steps=0, lr=2e-2)
+    init_fn, _, _, _ = bert_mod.make_bert(cfg)
+    params = jax.tree.map(np.asarray, init_fn(jax.random.PRNGKey(0)))
+    ids, labels = _bert_batch(seed=3)
+    batchq = (ids[0], labels[0])
+
+    eng = StreamedOffloadEngine(cfg, scfg, host_params=params)
+    losses = [eng.train_batch(batchq) for _ in range(10)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] - 0.3, losses          # (c) descends
+    # (a) per-step masks differ: step-to-step deltas are not the smooth
+    # near-constant sequence a dropout-free fixed batch produces
+    eng0 = StreamedOffloadEngine(
+        _bert_cfg(), scfg, host_params=params)
+    base = [eng0.train_batch(batchq) for _ in range(3)]
+    assert abs((losses[1] - losses[0]) - (base[1] - base[0])) > 1e-4
+    # (b) deterministic across engines with the same seed
+    eng2 = StreamedOffloadEngine(cfg, scfg, host_params=params)
+    losses2 = [eng2.train_batch(batchq) for _ in range(3)]
+    np.testing.assert_array_equal(losses[:3], losses2)
 
 
 # ------------------------------------------------------------------ #
@@ -893,6 +921,35 @@ def test_initialize_streaming_config_validation():
     bad["streaming"]["wire_bitz"] = 4
     with pytest.raises(ValueError, match="wire_bitz"):
         ds.initialize(model=cfg, config=bad)
+    # non-Adam optimizer types would silently train as Adam: reject
+    bad = _streaming_ds_config()
+    bad["optimizer"] = {"type": "OneBitLamb", "params": {"lr": 1e-4}}
+    with pytest.raises(ValueError, match="OneBitLamb"):
+        ds.initialize(model=cfg, config=bad)
+    # warmup_max_lr conflicting with the optimizer lr: reject; alone it
+    # IS the peak lr
+    bad = _streaming_ds_config()
+    bad["scheduler"] = {"type": "WarmupLR",
+                        "params": {"warmup_num_steps": 5,
+                                   "warmup_max_lr": 9e-4}}
+    with pytest.raises(ValueError, match="warmup_max_lr"):
+        ds.initialize(model=cfg, config=bad)
+    ok = _streaming_ds_config()
+    del ok["optimizer"]["params"]["lr"]
+    ok["scheduler"] = {"type": "WarmupLR",
+                       "params": {"warmup_num_steps": 5,
+                                  "warmup_max_lr": 9e-4}}
+    from deeperspeed_tpu.runtime.config import TrainingConfig
+    from deeperspeed_tpu.runtime.offload.streaming import (
+        stream_config_from_ds_config)
+
+    scfg = stream_config_from_ds_config(
+        TrainingConfig(ok, world_size=1), cfg)
+    assert scfg.lr == 9e-4
+    # compact-checkpoint bit widths are validated at construction
+    with pytest.raises(ValueError, match="ckpt_moment_bits"):
+        StreamedOffloadEngine(cfg, StreamConfig(
+            micro_batch=B, seq=S, ckpt_moment_bits=6))
 
 
 def test_streaming_dp_mesh_matches_single_device(monkeypatch):
