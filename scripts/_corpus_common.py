@@ -1,0 +1,58 @@
+"""Shared corpus plumbing for the convergence gates
+(corpus_convergence.py / onebit_convergence.py): windowing, the fixed
+held-out split, the epoch-shuffled batch stream, and the eval sets.
+One implementation so the two gates can never diverge on what "the
+held-out split" means."""
+
+import os
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_corpus():
+    return np.load(os.path.join(REPO, "data", "corpus_tokens.npy"))
+
+
+class CorpusSplit:
+    def __init__(self, tokens, seq: int, micro: int,
+                 eval_frac: float = 0.05, eval_batches: int = 8):
+        self.tokens = tokens
+        self.seq = seq
+        self.micro = micro
+        n_win = tokens.size // (seq + 1)
+        self.n_eval = max(micro, int(n_win * eval_frac))
+        # FIXED tail slice of windows (deterministic across legs/rounds),
+        # never seen by the training shuffle
+        self.train_win = np.arange(n_win - self.n_eval)
+        eval_win = np.arange(n_win - self.n_eval, n_win)
+        r_ev = np.random.default_rng(1)
+        self.eval_sets = [
+            np.stack([self.window(w) for w in
+                      r_ev.choice(eval_win, size=micro, replace=False)]
+                     ).astype(np.int32)
+            for _ in range(eval_batches)]
+
+    def window(self, w):
+        s = self.seq
+        return self.tokens[w * (s + 1):(w + 1) * (s + 1)]
+
+    def batches(self, steps):
+        """Contiguous windows, epoch-shuffled — real document order
+        inside each sample (synthetic gates lack exactly this)."""
+        r = np.random.default_rng(0)
+        order = r.permutation(self.train_win)
+        idx = 0
+        for _ in range(steps):
+            rows = [self.window(order[(idx + j) % self.train_win.size])
+                    for j in range(self.micro)]
+            idx += self.micro
+            yield np.stack(rows).astype(np.int32)
+
+    def eval_mean(self, eval_loss_fn, params):
+        import jax
+
+        return float(np.mean([
+            float(jax.device_get(eval_loss_fn(params, b)))
+            for b in self.eval_sets]))

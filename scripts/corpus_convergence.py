@@ -62,6 +62,12 @@ def main():
     ap.add_argument("--eval-frac", type=float, default=0.05)
     ap.add_argument("--eval-batches", type=int, default=8)
     ap.add_argument("--configs", default="zero0,zero1,zero2,masterless")
+    # smaller geometry for the CPU-mesh parity legs (sharded-layout
+    # parity is model-size independent; 125M at ~50 GFLOP/s of host CPU
+    # would be hours/leg)
+    ap.add_argument("--n-layer", type=int, default=12)
+    ap.add_argument("--d-model", type=int, default=768)
+    ap.add_argument("--n-head", type=int, default=12)
     ap.add_argument("--out",
                     default=os.path.join(REPO, "CONVERGENCE_CORPUS.json"))
     args = ap.parse_args()
@@ -72,51 +78,37 @@ def main():
     import deeperspeed_tpu as ds
     from deeperspeed_tpu.models.gpt import GPTConfig, make_gpt
 
-    tokens = np.load(os.path.join(REPO, "data", "corpus_tokens.npy"))
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from _corpus_common import CorpusSplit, load_corpus
+
+    tokens = load_corpus()
     vocab = 16384
     print(f"corpus: {tokens.size:,} tokens", flush=True)
 
-    cfg = GPTConfig(vocab_size=vocab, n_layer=12, n_head=12, d_model=768,
+    cfg = GPTConfig(vocab_size=vocab, n_layer=args.n_layer,
+                    n_head=args.n_head, d_model=args.d_model,
                     max_seq=args.seq, remat=False, ce_chunk=0)
     init_fn, _, loss_fn, _ = make_gpt(cfg)
 
     seq = args.seq
-    n_win = tokens.size // (seq + 1)
-    n_eval = max(args.micro, int(n_win * args.eval_frac))
-    # held-out split: a FIXED tail slice of windows (deterministic across
-    # legs and rounds), never seen by the training shuffle
-    train_win = np.arange(n_win - n_eval)
-    eval_win = np.arange(n_win - n_eval, n_win)
-
-    def window(w):
-        return tokens[w * (seq + 1):(w + 1) * (seq + 1)]
-
-    def batches(steps, micro):
-        """Contiguous windows, epoch-shuffled — real document order inside
-        each sample (synthetic gates lack exactly this)."""
-        r = np.random.default_rng(0)
-        order = r.permutation(train_win)
-        idx = 0
-        for _ in range(steps):
-            rows = [window(order[(idx + j) % train_win.size])
-                    for j in range(micro)]
-            idx += micro
-            yield np.stack(rows).astype(np.int32)
-
-    r_ev = np.random.default_rng(1)
-    eval_sets = [
-        np.stack([window(w) for w in
-                  r_ev.choice(eval_win, size=args.micro, replace=False)]
-                 ).astype(np.int32)
-        for _ in range(args.eval_batches)]
-
+    split = CorpusSplit(tokens, seq, args.micro,
+                        eval_frac=args.eval_frac,
+                        eval_batches=args.eval_batches)
+    n_eval = split.n_eval
     eval_loss_fn = jax.jit(loss_fn)
 
     dp = len(jax.devices())
+    assert args.micro % dp == 0, (
+        f"--micro {args.micro} must be divisible by the device count {dp}")
     platform = jax.devices()[0].platform
     section_key = f"{platform}_dp{dp}"
+    if (args.n_layer, args.d_model, args.n_head) != (12, 768, 12):
+        section_key += f"_L{args.n_layer}d{args.d_model}h{args.n_head}"
+    section_geom = {"n_layer": args.n_layer, "d_model": args.d_model,
+                    "n_head": args.n_head}
     section = {
         "steps": args.steps, "micro": args.micro, "seq": seq,
+        "geometry": section_geom,
         "corpus_tokens": int(tokens.size), "vocab": vocab,
         "platform": platform, "dp": dp,
         "device": str(jax.devices()[0].device_kind),
@@ -144,15 +136,13 @@ def main():
         del params
         losses = []
         t0 = time.perf_counter()
-        for i, batch in enumerate(batches(args.steps, args.micro)):
+        for i, batch in enumerate(split.batches(args.steps)):
             loss = engine.train_batch(batch)
             if i % 20 == 0:
                 losses.append(round(float(jax.device_get(loss)), 4))
         losses.append(round(float(jax.device_get(loss)), 4))
         dt = time.perf_counter() - t0
-        ev = float(np.mean([
-            float(jax.device_get(eval_loss_fn(engine.state.params, b)))
-            for b in eval_sets]))
+        ev = split.eval_mean(eval_loss_fn, engine.state.params)
         section["losses_every_20"][name] = losses
         section["first_loss"][name] = losses[0]
         section["tail_mean"][name] = round(float(np.mean(losses[-5:])), 4)

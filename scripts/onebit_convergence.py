@@ -61,7 +61,10 @@ def main():
     from deeperspeed_tpu.runtime.comm.onebit_spmd import (
         make_onebit_lamb_spmd_train_step, make_onebit_spmd_train_step)
 
-    tokens = np.load(os.path.join(REPO, "data", "corpus_tokens.npy"))
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from _corpus_common import CorpusSplit, load_corpus
+
+    tokens = load_corpus()
     vocab = 16384
     cfg = GPTConfig(vocab_size=vocab, n_layer=args.n_layer,
                     n_head=args.n_head, d_model=args.d_model,
@@ -69,37 +72,25 @@ def main():
     init_fn, _, loss_fn, _ = make_gpt(cfg)
 
     dp = len(jax.devices())
+    assert args.micro % dp == 0, (
+        f"--micro {args.micro} must be divisible by the device count {dp}")
     mesh = build_mesh({"data": dp})
     seq = args.seq
-    n_win = tokens.size // (seq + 1)
-    n_eval = max(args.micro, int(n_win * args.eval_frac))
-    train_win = np.arange(n_win - n_eval)
-    eval_win = np.arange(n_win - n_eval, n_win)
-
-    def window(w):
-        return tokens[w * (seq + 1):(w + 1) * (seq + 1)]
-
-    def batches(steps):
-        r = np.random.default_rng(0)
-        order = r.permutation(train_win)
-        idx = 0
-        for _ in range(steps):
-            rows = [window(order[(idx + j) % train_win.size])
-                    for j in range(args.micro)]
-            idx += args.micro
-            yield np.stack(rows).astype(np.int32)
-
-    r_ev = np.random.default_rng(1)
-    eval_sets = [
-        np.stack([window(w) for w in
-                  r_ev.choice(eval_win, size=args.micro, replace=False)]
-                 ).astype(np.int32)
-        for _ in range(args.eval_batches)]
+    split = CorpusSplit(tokens, seq, args.micro,
+                        eval_frac=args.eval_frac,
+                        eval_batches=args.eval_batches)
     eval_loss_fn = jax.jit(loss_fn)
 
     def lr_at(t):
+        """Warmup -> linear decay to 10% (the standard production shape;
+        the reference's 1-bit runs decay through the compressed phase —
+        a flat peak lr on frozen variance is exactly the configuration
+        that blows up rare-token rows)."""
         warm = 100
-        return args.lr * min(t / warm, 1.0)
+        if t <= warm:
+            return args.lr * t / warm
+        frac = (t - warm) / max(args.steps - warm, 1)
+        return args.lr * (1.0 - 0.9 * frac)
 
     def run_leg(name):
         compressed = name.startswith("onebit")
@@ -117,7 +108,7 @@ def main():
         comp_step = None
         losses = []
         t0 = time.perf_counter()
-        for t, batch in enumerate(batches(args.steps), start=1):
+        for t, batch in enumerate(split.batches(args.steps), start=1):
             if t <= freeze:
                 params, comm, loss = warm_step(
                     params, comm, batch, lr_at(t), t)
@@ -131,9 +122,7 @@ def main():
                 losses.append(round(float(jax.device_get(loss)), 4))
         losses.append(round(float(jax.device_get(loss)), 4))
         dt = time.perf_counter() - t0
-        ev = float(np.mean([
-            float(jax.device_get(eval_loss_fn(params, b)))
-            for b in eval_sets]))
+        ev = split.eval_mean(eval_loss_fn, params)
         return losses, round(dt, 1), round(ev, 4)
 
     section = {
@@ -146,8 +135,6 @@ def main():
         "note": ("dp=1 still applies full sign quantization + dual error "
                  "feedback (see module docstring); wire reduction audited "
                  "separately at dp8 in ONEBIT_WIRE.json")}
-    import numpy as np  # noqa: F811
-
     for name in args.legs.split(","):
         name = name.strip()
         losses, secs, ev = run_leg(name)
@@ -171,6 +158,27 @@ def main():
         out = {"sections": {}}
     if "sections" not in out:
         out = {"sections": {}, "note_r4_artifact": out}
+    prev = out["sections"].get("onebit")
+    same_run = prev and all(
+        prev.get(k) == section[k]
+        for k in ("steps", "micro", "seq", "freeze_step", "dp",
+                  "platform"))
+    if same_run:
+        # merge per-leg results (reruns of individual legs keep the rest)
+        for key in ("losses_every_20", "tail_mean", "eval_loss",
+                    "eval_ppl", "seconds"):
+            merged = dict(prev.get(key, {}))
+            merged.update(section[key])
+            section[key] = merged
+        for key, val in prev.items():
+            section.setdefault(key, val)
+        tails = section["tail_mean"]
+        for base, comp in (("adam", "onebit_adam"),
+                           ("lamb", "onebit_lamb")):
+            if base in tails and comp in tails:
+                section[f"{comp}_parity_ok"] = bool(
+                    abs(tails[comp] - tails[base])
+                    < 0.05 * abs(tails[base]))
     out["sections"]["onebit"] = section
     with open(args.out, "w") as f:
         json.dump(out, f, indent=1)
