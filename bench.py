@@ -127,6 +127,12 @@ def main():
     embed_params = sum(p.size for p in jax.tree.leaves(params["embed"]))
     n_matmul_params = n_params - embed_params
 
+    # grad accumulation dtype A/B knob (DS_BENCH_ACCUM=bf16|fp32): the
+    # gas-scan's accumulator is read+written every micro — at 1.3B that is
+    # 2.6GB of grads x 4B fp32 of HBM traffic per micro; bf16 halves it
+    accum_env = os.environ.get("DS_BENCH_ACCUM")
+    if accum_env:
+        precision = {**precision, "grad_accum_dtype": accum_env}
     ds_cfg = {
         "train_micro_batch_size_per_gpu": micro,
         "gradient_accumulation_steps": gas,

@@ -128,7 +128,7 @@ def _dense_ref(q, k, v, layout, causal):
     return block_sparse_attention_xla(q, k, v, layout, BLOCK, causal=causal)
 
 
-@pytest.mark.parametrize("impl", ["stream", "resident"])
+@pytest.mark.parametrize("impl", ["stream", "resident", "split"])
 @pytest.mark.parametrize("causal", [False, True])
 def test_kernel_matches_dense_mask_fixed(causal, impl):
     cfg = FixedSparsityConfig(
@@ -145,7 +145,7 @@ def test_kernel_matches_dense_mask_fixed(causal, impl):
                                atol=2e-5)
 
 
-@pytest.mark.parametrize("impl", ["stream", "resident"])
+@pytest.mark.parametrize("impl", ["stream", "resident", "split"])
 def test_kernel_matches_dense_mask_bigbird(impl):
     cfg = BigBirdSparsityConfig(num_heads=H, block=BLOCK, num_random_blocks=1,
                                 num_sliding_window_blocks=3, num_global_blocks=1)
@@ -172,7 +172,7 @@ def test_kernel_empty_rows_zero_output(impl):
     assert np.abs(out[:, 8:]).max() == 0.0  # rows beyond block 0: no keys
 
 
-@pytest.mark.parametrize("impl", ["stream", "resident"])
+@pytest.mark.parametrize("impl", ["stream", "resident", "split"])
 def test_kernel_grads_match_dense_mask(impl):
     cfg = BSLongformerSparsityConfig(num_heads=H, block=BLOCK,
                                      num_sliding_window_blocks=3)
@@ -258,7 +258,7 @@ def test_bert_sparse_self_attention():
     assert np.isfinite(np.asarray(out)).all()
 
 
-@pytest.mark.parametrize("impl", ["stream", "resident"])
+@pytest.mark.parametrize("impl", ["stream", "resident", "split"])
 def test_kernel_grads_match_dense_mask_causal(impl):
     """Causal grads: exercises the dkdv kernels' diagonal-block masking
     (for the resident path, the transposed chunk LUT's full/masked
@@ -362,3 +362,51 @@ def test_auto_never_changes_semantics():
     ref = block_sparse_attention_xla(q, q, q, layout, block, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-2, rtol=2e-2)
+
+
+# ------------------- strided-global split path --------------------- #
+
+
+def test_split_global_columns_strips_strided():
+    """Fixed's every-Nth global columns strip out; windowed content
+    stays; no formerly-nonempty row is emptied; waste drops into the
+    resident range the split path requires."""
+    from deeperspeed_tpu.ops.sparse_attention.kernels import (
+        split_global_columns, supertile_covered)
+
+    cfg = FixedSparsityConfig(
+        num_heads=1, block=BLOCK, num_local_blocks=2, num_global_blocks=1,
+        attention="unidirectional")
+    lay = np.asarray(cfg.make_layout(BLOCK * 32)) != 0
+    lay = lay & np.tril(np.ones((32, 32), bool))[None]
+    rest, cols, colmask = split_global_columns(lay)
+    assert (cols >= 0).sum() > 0
+    # stripped + rest == original, disjoint
+    re = np.zeros_like(lay)
+    for h in range(lay.shape[0]):
+        for j, c in enumerate(cols[h]):
+            if c >= 0:
+                re[h, :, c] = colmask[h, :, j]
+    assert not (re & rest).any()
+    assert ((re | rest) == lay).all()
+    # no emptied rows
+    assert not (((~rest.any(axis=2)) & lay.any(axis=2)).any())
+    # the decision quantity: ABSOLUTE covered area (iterations), which
+    # must drop sharply even though the remainder's waste RATIO rises
+    assert supertile_covered(rest) < 0.67 * supertile_covered(lay)
+
+
+def test_split_path_with_no_global_columns_degenerates():
+    """Forcing impl='split' on a pure sliding-window layout (nothing to
+    strip) must still match the reference (the dense pass contributes
+    zero weight everywhere)."""
+    cfg = BSLongformerSparsityConfig(num_heads=H, block=BLOCK,
+                                     num_sliding_window_blocks=3)
+    layout = cfg.make_layout(32)
+    q, k, v = _qkv(jax.random.PRNGKey(9), S=32)
+    attend = make_block_sparse_attention(layout, BLOCK, interpret=True,
+                                         impl="split")
+    out = jax.jit(attend)(q, k, v)
+    ref = _dense_ref(q, k, v, layout, False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5,
+                               atol=2e-5)
