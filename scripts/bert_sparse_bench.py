@@ -222,6 +222,10 @@ def bench_sparse_vs_dense(S: int, steps: int, sparsity_cfg=None,
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--only-sparse", action="store_true",
+                    help="skip the BERT engine benches; sparse sweep only")
+    ap.add_argument("--seqs", type=int, nargs="*", default=None,
+                    help="restrict the sparse sweep to these seq lens")
     args = ap.parse_args()
     steps = 5 if args.quick else 10
 
@@ -231,7 +235,7 @@ def main():
         "bert_large_zero2": [],
         "sparse_vs_dense": [],
     }
-    for seq, micro in ((128, 64), (512, 16)):
+    for seq, micro in (() if args.only_sparse else ((128, 64), (512, 16))):
         # masterless bf16: r4 hardware grid measured +3.5 TF at both seqs
         # (optimizer state traffic halves); convergence equivalence is
         # gated by tests/test_model_convergence.py (incl. the
@@ -280,6 +284,8 @@ def main():
             num_sliding_window_blocks=3, num_global_blocks=1,
             attention="unidirectional")),
     ]
+    if args.seqs:
+        sweep = [(S, c) for S, c in sweep if S in set(args.seqs)]
     for S, scfg in sweep:
         # steps=16: the harness carries a measured ~5ms fixed cost per scan
         # iteration through the tunnel; short scans bias ratios toward 1
@@ -293,6 +299,23 @@ def main():
         print(json.dumps(r), flush=True)
 
     path = os.path.join(REPO, "BENCH_EXTRA.json")
+    if args.only_sparse or args.seqs:
+        # partial sweep: merge into the existing artifact instead of
+        # clobbering the rows this invocation did not measure
+        try:
+            with open(path) as f:
+                prev = json.load(f)
+        except FileNotFoundError:
+            prev = {}
+        if not args.only_sparse:
+            prev["bert_large_zero2"] = out["bert_large_zero2"]
+        kept = [r for r in prev.get("sparse_vs_dense", [])
+                if r.get("seq") not in {r2.get("seq")
+                                        for r2 in out["sparse_vs_dense"]}]
+        prev["sparse_vs_dense"] = kept + out["sparse_vs_dense"]
+        prev["platform"] = out["platform"]
+        prev["tpu_gen"] = out["tpu_gen"]
+        out = prev
     with open(path, "w") as f:
         json.dump(out, f, indent=1)
     print(f"wrote {path}")
