@@ -13,7 +13,8 @@ Method (chained-scan differenced timing, the MFU_DECOMP methodology):
                 VPU-side floor at this score-tensor size.
   full_xla    — the real XLA attention (what attn_impl='auto' runs at
                 S<=256).
-  full_flash / full_static — the Pallas kernels for comparison.
+  full_flash_v1 / full_static — the two Pallas kernels, each forced
+                explicitly (the auto dispatch would hide which ran).
 
 If t(full) ~= max-ish combination of t(matmul_only) and t(softmax_only),
 the ceiling is arithmetic-bound (VPU dominating at Dh=64 where the
@@ -25,7 +26,6 @@ Usage: python scripts/attn_roofline.py [--geom bert128 bert512]
 """
 
 import argparse
-import functools
 import json
 import os
 import sys
@@ -48,7 +48,9 @@ GEOMS = {
 
 def _time_chained(make_step, x0, steps_a=8, steps_b=32):
     """Differenced chained-scan timing: run scan of N dependent steps for
-    two lengths; (t_b - t_a) / (b - a) cancels dispatch + fixed costs."""
+    two lengths; (t_b - t_a) / (b - a) cancels dispatch + fixed costs.
+    Pallas legs must keep steps_b <= 24 (longer chains explode Mosaic
+    compile time on the tunnel — r4 measurement rules)."""
 
     def runner(n):
         @jax.jit
@@ -88,15 +90,20 @@ def bench_geom(name, B, H, S, Dh, causal):
                                 preferred_element_type=jnp.float32)
         return o.astype(jnp.bfloat16)
 
+    coef = 1.0 + 0.01 * jnp.arange(S, dtype=jnp.float32)
+
     def softmax_only(x):
         # score-tensor-shaped VPU work: the real softmax's max/sub/exp/
-        # sum/div over (B,H,S,S) fp32, fed back through a reduction so the
+        # sum/div over a (B,H,S,S) fp32 tensor that VARIES along the
+        # reduced axis (outer product with an iota ramp — a broadcast of
+        # one column would let XLA fold the reductions away and the leg
+        # would measure nothing), fed back through a reduction so the
         # chain stays dependent
-        s = jnp.broadcast_to(x[..., :1], (B, H, S, S)).astype(jnp.float32)
+        s = x[..., 0].astype(jnp.float32)[..., :, None] * coef[None, :]
         m = jnp.max(s, axis=-1, keepdims=True)
         p = jnp.exp(s - m)
         p = p / jnp.sum(p, axis=-1, keepdims=True)
-        return (x + jnp.mean(p, axis=-1, keepdims=True)[..., 0:Dh]
+        return (x + jnp.mean(p, axis=-1, keepdims=True)
                 .astype(jnp.bfloat16))
 
     def full_xla(x):
@@ -119,18 +126,29 @@ def bench_geom(name, B, H, S, Dh, causal):
         dt = _time_chained(fn, q)
         out[key] = {"ms": round(dt * 1e3, 4),
                     "tflops_equiv": round(flops / dt / 1e12, 1)}
-    try:
-        from deeperspeed_tpu.ops.pallas.flash_attention import (
-            flash_attention_bhsd)
+    # both Pallas kernels, forced explicitly; chain capped at 24 (Mosaic
+    # compile time explodes past that on the tunnel)
+    from deeperspeed_tpu.ops.pallas import flash_static
+    from deeperspeed_tpu.ops.pallas.flash_attention import (
+        flash_attention_bhsd)
 
-        dt = _time_chained(
-            functools.partial(lambda x: flash_attention_bhsd(
-                x, x, x, causal=causal).astype(jnp.bfloat16)), q)
-        out["full_flash_auto"] = {"ms": round(dt * 1e3, 4),
-                                  "tflops_equiv": round(flops / dt / 1e12,
-                                                        1)}
-    except Exception as e:  # noqa: BLE001
-        out["full_flash_auto"] = {"error": str(e)[:120]}
+    for key, fn in (
+        ("full_flash_v1",
+         # explicit block sizes force the v1 streaming kernel (no auto
+         # dispatch to the static kernel)
+         lambda x: flash_attention_bhsd(
+             x, x, x, causal=causal, block_q=min(128, S),
+             block_k=min(128, S)).astype(jnp.bfloat16)),
+        ("full_static",
+         lambda x: flash_static.flash_attention_static_bhsd(
+             x, x, x, causal=causal).astype(jnp.bfloat16)),
+    ):
+        try:
+            dt = _time_chained(fn, q, steps_a=8, steps_b=24)
+            out[key] = {"ms": round(dt * 1e3, 4),
+                        "tflops_equiv": round(flops / dt / 1e12, 1)}
+        except Exception as e:  # noqa: BLE001
+            out[key] = {"error": str(e)[:120]}
     # the verdict's question: is full ~= mxu + vpu floors?
     mxu = out["matmul_only"]["ms"]
     vpu = out["softmax_only"]["ms"]
