@@ -1,0 +1,18 @@
+#!/bin/sh
+# Chip session A: flagship ablation + BERT remat-policy probes.
+# Serialized — one chip job at a time, quiet host assumed.
+cd "$(dirname "$0")/../.."
+echo "=== flagship step ablation ==="
+python scripts/step_ablation.py --variants base,no_remat,dots_all --steps 12 2>&1
+echo "=== bert probes seq128 mb64 ==="
+python scripts/bert_variant_probe.py 128 64 masterless=1 2>&1 | grep VARIANT
+python scripts/bert_variant_probe.py 128 64 masterless=1 policy=dots_all 2>&1 | grep VARIANT
+python scripts/bert_variant_probe.py 128 64 masterless=1 remat=0 2>&1 | grep VARIANT
+python scripts/bert_variant_probe.py 128 48 masterless=1 remat=0 2>&1 | grep VARIANT
+echo "=== bert probes seq512 mb16 ==="
+python scripts/bert_variant_probe.py 512 16 masterless=1 2>&1 | grep VARIANT
+python scripts/bert_variant_probe.py 512 16 masterless=1 policy=dots_all 2>&1 | grep VARIANT
+python scripts/bert_variant_probe.py 512 16 masterless=1 remat=0 2>&1 | grep VARIANT
+echo "=== done ==="
+echo "=== sparse split A/B S=4096 (Fixed + BigBird) ==="
+python scripts/bert_sparse_bench.py --only-sparse --seqs 4096 2>&1 | tail -20

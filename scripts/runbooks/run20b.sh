@@ -1,0 +1,13 @@
+#!/bin/sh
+# 20B north-star session 1: >=3 steps, compact save at step 2.
+# PRECONDITIONS: chip alive (tpu_smoke), quiet host, >=75GB free disk.
+cd "$(dirname "$0")/../.."
+rm -rf /tmp/ds_tpu_stream_swap /tmp/ck20b
+env MALLOC_MMAP_THRESHOLD_=65536 PYTHONPATH=/root/repo \
+python scripts/infinity_stream.py \
+  --model 20b --steps 3 --seq 1024 --micro-batch 1 \
+  --wire-bits 4 --resident-bits 4 --host-state bf16 \
+  --swap-states exp_avg_sq --state nvme \
+  --fixed-batch --lr 8e-6 --warmup 14 \
+  --ckpt-dir /tmp/ck20b --save-every 2 --ckpt-compact \
+  --out INFINITY_20B.json

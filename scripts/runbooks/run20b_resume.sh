@@ -1,0 +1,12 @@
+#!/bin/sh
+# 20B session 2: fresh process resumes from the step-2 compact
+# checkpoint and finishes steps 3-4 (VERDICT items 1+5 demo).
+cd "$(dirname "$0")/../.."
+env MALLOC_MMAP_THRESHOLD_=65536 PYTHONPATH=/root/repo \
+python scripts/infinity_stream.py \
+  --model 20b --steps 2 --seq 1024 --micro-batch 1 \
+  --wire-bits 4 --resident-bits 4 --host-state bf16 \
+  --swap-states exp_avg_sq --state nvme \
+  --fixed-batch --lr 8e-6 --warmup 14 \
+  --ckpt-dir /tmp/ck20b --save-every 99 --ckpt-compact --resume \
+  --out INFINITY_20B_RESUME.json
