@@ -28,6 +28,7 @@ from .ops.transformer import DeepSpeedTransformerLayer, DeepSpeedTransformerConf
 from .module_inject import replace_transformer_layer, module_inject
 from .utils import logger, log_dist
 from .utils.distributed import init_distributed
+from .serving import PipelineServingBridge, ServingConfig, ServingEngine
 
 
 def add_config_arguments(parser):

@@ -275,6 +275,32 @@ class TrainingConfig:
                 or (self.zero_optimization_stage == 3
                     and self.zero_config.offload_param.enabled)))
 
+        # ---- continuous-batching serving ----
+        # A "serving" block configures the inference engine (serving/
+        # package); it does not change training behavior. Built eagerly
+        # so config typos fail at load time; read via serving_config().
+        self.serving_params = pd.get(c.SERVING, None)
+        if self.serving_params is not None and not isinstance(
+                self.serving_params, dict):
+            raise ConfigError(
+                '"serving" must be a dict of ServingConfig overrides '
+                '(or {"enabled": false})'
+            )
+        explicit_serving = (self.serving_params or {}).get(c.SERVING_ENABLED)
+        self.serving_enabled = (
+            explicit_serving if explicit_serving is not None
+            else self.serving_params is not None
+        )
+        self._serving_config = None
+        if self.serving_enabled:
+            from ..serving.config import ServingConfig
+
+            try:
+                self._serving_config = ServingConfig.from_dict(
+                    self.serving_params)
+            except ValueError as e:
+                raise ConfigError(f'invalid "serving" block: {e}') from e
+
         bs_sched = pd.get(c.BATCH_SCHEDULER, {})
         if isinstance(bs_sched, dict):
             self.batch_scheduler_enabled = bs_sched.get(
@@ -286,6 +312,12 @@ class TrainingConfig:
             self.batch_scheduler_params = {}
 
         self.gradient_noise_scale = pd.get(c.GRADIENT_NOISE_SCALE, None)
+
+    def serving_config(self):
+        """The "serving" block as a ServingConfig (None when the block is
+        absent or disabled). Built — and validated — at parse time so
+        config typos fail at load, like every other block."""
+        return self._serving_config
 
     def get_sparse_attention(self, num_heads: int):
         """Build the configured SparsityConfig (reference runtime/config.py:213

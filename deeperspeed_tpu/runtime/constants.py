@@ -213,3 +213,12 @@ SPARSE_DIFFERENT_LAYOUT_PER_HEAD_DEFAULT = False
 #############################################
 STREAMING = "streaming"
 STREAMING_ENABLED = "enabled"
+
+#############################################
+# Continuous-batching inference serving (serving/ package): slot pool,
+# paged KV cache geometry, admission policy. Keys are validated by
+# serving.config.ServingConfig.from_dict.
+#############################################
+SERVING = "serving"
+SERVING_ENABLED = "enabled"
+SERVING_ENABLED_DEFAULT = False

@@ -766,6 +766,15 @@ class PipelineEngine(ConfigAccessorsMixin):
             self.micro_batches = saved
         return self._outputs_final[-1]
 
+    def serving_logits_fn(self):
+        """The logits function the continuous-batching bridge drives
+        (serving.PipelineServingBridge.from_pipeline_engine): one
+        full-prefix forward per call through the pipelined stages. This
+        is the reference fork's serving mode (per-token inference_batch
+        with prefix recompute) behind the serving/ package's
+        submit/step/run surface."""
+        return self.inference_batch
+
     def _aggregate_total_loss(self):
         """DP-mean already taken inside each jitted loss; average over
         micro-batches (reference _aggregate_total_loss :559)."""

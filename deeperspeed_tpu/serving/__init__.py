@@ -1,0 +1,37 @@
+"""Continuous-batching inference serving (the inference half of the
+roadmap's north star).
+
+``ServingEngine`` turns concurrent requests into efficient fixed-shape
+decode batches over a slot pool backed by a paged KV cache;
+``PipelineServingBridge`` exposes the same surface over
+``PipelineEngine.inference_batch`` for pipelined models. See
+docs/tutorials/serving.md for the walkthrough.
+"""
+
+from .config import ServingConfig
+from .engine import PipelineServingBridge, ServingEngine, make_decode_step
+from .kv_cache import BlockAllocator, PagedKVCache, blocks_needed
+from .metrics import ServingMetrics
+from .scheduler import (
+    FINISH_EOS,
+    FINISH_LENGTH,
+    FINISH_TIMEOUT,
+    Request,
+    Scheduler,
+)
+
+__all__ = [
+    "ServingConfig",
+    "ServingEngine",
+    "PipelineServingBridge",
+    "make_decode_step",
+    "BlockAllocator",
+    "PagedKVCache",
+    "blocks_needed",
+    "ServingMetrics",
+    "Scheduler",
+    "Request",
+    "FINISH_EOS",
+    "FINISH_LENGTH",
+    "FINISH_TIMEOUT",
+]

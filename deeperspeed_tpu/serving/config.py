@@ -1,0 +1,137 @@
+"""Serving-engine configuration.
+
+The inference counterpart of ``runtime/config.py``'s training blocks: a
+``"serving"`` block in the master JSON config (or a plain dict) builds a
+``ServingConfig``. All sizes here are STATIC — they fix the shapes of the
+jitted decode step (slot count, block-table width) and of the paged KV
+pool, so requests can join and leave without ever recompiling.
+
+Geometry:
+
+  * ``num_slots`` decode slots — the fixed batch dimension of the decode
+    step. A request occupies one slot from admission to eviction.
+  * The KV pool holds ``num_blocks`` blocks of ``block_size`` tokens each
+    (block 0 is reserved as the null block that idle slots and padding
+    point at). Long and short requests draw from the SAME pool — no
+    per-request max-length reservation, which is the whole point of
+    paging (vLLM's PagedAttention insight).
+  * Prefill pads prompts up to a length bucket (multiples of
+    ``block_size``, doubling), so prefill compiles once per bucket rather
+    than once per prompt length.
+"""
+
+import dataclasses
+import math
+from typing import Optional, Tuple
+
+_KNOWN_KEYS = frozenset({
+    "enabled", "num_slots", "block_size", "num_blocks", "max_seq_len",
+    "max_new_tokens", "eos_token_id", "top_k", "request_timeout_s",
+    "prefill_buckets", "seed",
+})
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingConfig:
+    # slot pool: batch dimension of the one jitted decode step
+    num_slots: int = 8
+    # paged KV cache geometry; block 0 is the reserved null block
+    block_size: int = 16
+    num_blocks: int = 128
+    # hard cap on prompt_len + max_new_tokens per request (bounds the
+    # block-table width: ceil(max_seq_len / block_size) entries per slot)
+    max_seq_len: int = 512
+    # default per-request generation budget (requests may pass their own)
+    max_new_tokens: int = 64
+    # stop token; None disables EOS eviction
+    eos_token_id: Optional[int] = None
+    # static top-k for sampled (temperature > 0) slots; None = full vocab.
+    # Static because it shapes the decode step's lax.top_k — per-request
+    # top_k would recompile per value.
+    top_k: Optional[int] = None
+    # evict requests (queued or running) older than this; None = never
+    request_timeout_s: Optional[float] = None
+    # prefill length buckets; () derives doubling multiples of block_size
+    prefill_buckets: Tuple[int, ...] = ()
+    # base PRNG seed for sampled slots
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {self.num_slots}")
+        if self.block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {self.block_size}")
+        if self.max_seq_len < 1:
+            raise ValueError(f"max_seq_len must be >= 1, got {self.max_seq_len}")
+        # block 0 is the null block — at least one usable block is needed
+        if self.num_blocks < 2:
+            raise ValueError(
+                f"num_blocks must be >= 2 (block 0 is the reserved null "
+                f"block), got {self.num_blocks}"
+            )
+        if self.max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {self.max_new_tokens}"
+            )
+        if self.top_k is not None and self.top_k < 1:
+            raise ValueError(f"top_k must be >= 1 or None, got {self.top_k}")
+        buckets = self.prefill_buckets or self._default_buckets()
+        buckets = tuple(sorted(set(int(b) for b in buckets)))
+        for b in buckets:
+            if b < 1 or b % self.block_size:
+                raise ValueError(
+                    f"prefill bucket {b} must be a positive multiple of "
+                    f"block_size ({self.block_size})"
+                )
+        if buckets[-1] < self.max_seq_len:
+            raise ValueError(
+                f"largest prefill bucket ({buckets[-1]}) must cover "
+                f"max_seq_len ({self.max_seq_len})"
+            )
+        object.__setattr__(self, "prefill_buckets", buckets)
+
+    def _default_buckets(self):
+        buckets, b = [], self.block_size
+        while b < self.max_seq_len:
+            buckets.append(b)
+            b *= 2
+        buckets.append(self.blocks_per_slot * self.block_size)
+        return tuple(buckets)
+
+    @property
+    def blocks_per_slot(self) -> int:
+        """Block-table width: blocks a maximally long request occupies."""
+        return math.ceil(self.max_seq_len / self.block_size)
+
+    @property
+    def usable_blocks(self) -> int:
+        """Allocatable blocks (the pool minus the null block)."""
+        return self.num_blocks - 1
+
+    def bucket_for(self, length: int) -> int:
+        """Smallest prefill bucket covering ``length``."""
+        for b in self.prefill_buckets:
+            if b >= length:
+                return b
+        raise ValueError(
+            f"prompt length {length} exceeds the largest prefill bucket "
+            f"({self.prefill_buckets[-1]}); raise max_seq_len"
+        )
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "ServingConfig":
+        """Build from a ``"serving"`` config block. Unknown keys raise —
+        a typo'd knob silently falling back to its default is the classic
+        serving-config footgun."""
+        if d is None:
+            return cls()
+        unknown = set(d) - _KNOWN_KEYS
+        if unknown:
+            raise ValueError(
+                f"unknown serving config keys {sorted(unknown)}; known keys "
+                f"are {sorted(_KNOWN_KEYS)}"
+            )
+        kw = {k: v for k, v in d.items() if k != "enabled"}
+        if "prefill_buckets" in kw and kw["prefill_buckets"] is not None:
+            kw["prefill_buckets"] = tuple(kw["prefill_buckets"])
+        return cls(**kw)

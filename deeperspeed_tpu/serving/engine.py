@@ -1,0 +1,420 @@
+"""ServingEngine: continuous-batching inference over a fixed slot pool.
+
+The request lifecycle::
+
+    engine = ServingEngine(cfg, params, {"num_slots": 8, "num_blocks": 128})
+    rid = engine.submit([1, 2, 3], max_new_tokens=32)
+    while engine.has_work():
+        for req in engine.step():
+            print(req.rid, req.output)
+    # or: outputs = engine.run()
+
+One ``step()`` is: expire timeouts -> admit+prefill queued requests into
+free slots (length-bucketed, backpressure when the block pool is dry) ->
+grow block tables for the next write (preempting the youngest slot when
+the pool is exhausted) -> ONE jitted decode step over ALL slots -> append
+tokens, evict finished requests.
+
+Static-shape discipline: the decode step closes over (num_slots,
+blocks_per_slot) and always runs the full slot array — idle slots carry
+token 0 / length 0 / an all-null block table and their garbage lane is
+ignored on the host. Requests joining and leaving change only the DATA
+fed to the same compiled program, never its shapes, so the decode step
+compiles exactly once per engine (asserted in tests via the jit cache
+counter). Prefill compiles once per length bucket.
+
+Decode math reuses ``models/gpt.decoder_block`` (the same layer the
+training forward and ``models/generation`` use) with a paged-cache
+``attend`` (serving/kv_cache.paged_attend), which is what makes greedy
+serving outputs token-identical to per-request ``make_generator`` calls.
+
+``PipelineServingBridge`` gives pipelined models (PipelineModule over a
+'pipe' mesh) the same submit/step/run surface by driving
+``PipelineEngine.inference_batch`` with full-prefix recompute per token —
+the reference fork's serving mode, kept as the compatibility path until
+pipelined KV caching lands.
+"""
+
+import itertools
+import time
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.generation import apply_with_cache, init_cache, \
+    prep_sampling_logits
+from ..models.gpt import GPTConfig, decoder_block, layer_norm
+from ..utils.logging import logger
+from .config import ServingConfig
+from .kv_cache import PagedKVCache, blocks_needed, paged_attend
+from .metrics import DECODE_TIMER, PREFILL_TIMER, ServingMetrics
+from .scheduler import Request, Scheduler
+
+
+# ------------------------------------------------------------------ #
+# the jitted decode step
+# ------------------------------------------------------------------ #
+
+
+def _paged_block(cfg: GPTConfig, x, layer_params, k_l, v_l, tables,
+                 lengths, wblk, woff, positions):
+    """One decoder layer over all slots' single new tokens, reading and
+    writing the paged pool. The layer math is gpt.decoder_block — only
+    the attention core differs (mirrors generation._cached_block)."""
+
+    def attend(q, k, v):
+        ctx, k2, v2 = paged_attend(k_l, v_l, q, k, v, tables, lengths,
+                                   wblk, woff)
+        return ctx, (k2, v2)
+
+    moe_cfg = cfg.moe
+    if moe_cfg is not None:
+        from ..models.moe import moe_ffn
+
+        def mlp_fn(mlp_in):
+            return moe_ffn(layer_params["moe"], mlp_in, moe_cfg)
+
+        x, ((k_l, v_l), _) = decoder_block(
+            cfg, None, x, layer_params, positions, attend, mlp_fn=mlp_fn
+        )
+    else:
+        x, (k_l, v_l) = decoder_block(cfg, None, x, layer_params,
+                                      positions, attend)
+    return x, k_l, v_l
+
+
+def make_decode_step(cfg: GPTConfig, scfg: ServingConfig):
+    """Build the jitted all-slots decode step.
+
+    decode_step(params, k_pool, v_pool, tables, lengths, tokens, temps,
+    rng) -> (next_tokens (N,), k_pool', v_pool'). Pools are donated —
+    the caller's old handles die each step (no second pool in HBM).
+    temps[i] <= 0 selects greedy argmax for slot i; > 0 samples at that
+    temperature under the config's static top_k.
+    """
+    top_k = scfg.top_k
+    if top_k is not None and top_k >= cfg.vocab_size:
+        top_k = None  # full-vocab top-k is a no-op filter
+
+    @partial(jax.jit, donate_argnums=(1, 2))
+    def decode_step(params, k_pool, v_pool, tables, lengths, tokens,
+                    temps, rng):
+        cdt = cfg.dtype
+        N = tokens.shape[0]
+        wte = params["embed"]["wte"].astype(cdt)
+        x = jnp.take(wte, tokens, axis=0)[:, None, :]       # (N, 1, D)
+        positions = lengths[:, None]                        # (N, 1)
+        if not cfg.rotary:
+            x = x + jnp.take(params["embed"]["wpe"], positions,
+                             axis=0).astype(cdt)
+        wblk = tables[jnp.arange(N), lengths // scfg.block_size]
+        woff = lengths % scfg.block_size
+
+        def scan_body(carry, xs):
+            x = carry
+            layer_params, k_l, v_l = xs
+            x, k_l, v_l = _paged_block(cfg, x, layer_params, k_l, v_l,
+                                       tables, lengths, wblk, woff,
+                                       positions)
+            return x, (k_l, v_l)
+
+        x, (k_new, v_new) = jax.lax.scan(
+            scan_body, x, (params["layers"], k_pool, v_pool)
+        )
+        x = layer_norm(x, params["final_ln"]["scale"],
+                       params["final_ln"]["bias"], cfg.layernorm_eps)
+        if cfg.tie_embeddings:
+            logits = x @ params["embed"]["wte"].astype(cdt).T
+        else:
+            logits = x @ params["lm_head"].astype(cdt)
+        logits = logits[:, 0]                               # (N, V)
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        l32 = logits.astype(jnp.float32) / jnp.maximum(
+            temps, 1e-6)[:, None]
+        if top_k is not None:
+            kth = jax.lax.top_k(l32, top_k)[0][..., -1:]
+            l32 = jnp.where(l32 < kth, -1e30, l32)
+        sampled = jax.random.categorical(rng, l32, axis=-1).astype(
+            jnp.int32)
+        nxt = jnp.where(temps > 0.0, sampled, greedy)
+        return nxt, k_new, v_new
+
+    return decode_step
+
+
+# ------------------------------------------------------------------ #
+# shared submit/run surface
+# ------------------------------------------------------------------ #
+
+
+class _ServingBase:
+    """submit/step/run/metrics shared by ServingEngine and the pipeline
+    bridge; subclasses implement _admit_one (prefill) and _decode_all."""
+
+    def __init__(self, scfg: ServingConfig, scheduler: Scheduler,
+                 clock, monitor):
+        self.scfg = scfg
+        self.sched = scheduler
+        self.clock = clock
+        self.metrics = ServingMetrics(scfg.num_slots, clock, monitor)
+        self._rid_counter = itertools.count()
+        self._requests: Dict[str, Request] = {}
+        self._step_i = 0
+
+    # -- queue surface ------------------------------------------------ #
+
+    def submit(self, prompt: Union[Sequence[int], np.ndarray],
+               max_new_tokens: Optional[int] = None,
+               temperature: float = 0.0,
+               request_id: Optional[str] = None,
+               arrival_t: Optional[float] = None) -> str:
+        """Queue one request; returns its id. Raises when the request
+        could never fit (context cap / pool footprint) — everything else
+        is handled by scheduling, not by the caller."""
+        prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        rid = request_id if request_id is not None else \
+            f"req-{next(self._rid_counter)}"
+        if rid in self._requests:
+            raise ValueError(f"duplicate request id {rid!r}")
+        req = Request(
+            rid=rid,
+            prompt=prompt,
+            max_new_tokens=(self.scfg.max_new_tokens
+                            if max_new_tokens is None else max_new_tokens),
+            temperature=float(temperature),
+            arrival_t=self.clock() if arrival_t is None else arrival_t,
+        )
+        self.sched.submit(req)
+        self._requests[rid] = req
+        return rid
+
+    def get(self, rid: str) -> Request:
+        return self._requests[rid]
+
+    def has_work(self) -> bool:
+        return self.sched.has_work()
+
+    # -- the scheduler loop ------------------------------------------- #
+
+    def step(self) -> List[Request]:
+        """One scheduler iteration; returns requests finished by it."""
+        n_done = len(self.sched.finished)
+        now = self.clock()
+        for req in self.sched.expire_timeouts(now):
+            self.metrics.record_finish(req, now)
+        while (adm := self.sched.pop_admissible()) is not None:
+            self._admit_one(*adm)
+        for _ in self.sched.ensure_decode_capacity():
+            self.metrics.record_preemption()
+        if self.sched.num_active:
+            self._decode_all()
+        self._step_i += 1
+        self.metrics.export(self._step_i)
+        return self.sched.finished[n_done:]
+
+    def run(self, max_steps: Optional[int] = None) -> Dict[str, List[int]]:
+        """Drive step() until idle (or max_steps); returns {rid: tokens}
+        for every finished request."""
+        steps = 0
+        while self.has_work():
+            self.step()
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                break
+        return {r.rid: r.output for r in self.sched.finished}
+
+    # -- helpers ------------------------------------------------------ #
+
+    def _record_emitted(self, req: Request, prefill: bool) -> None:
+        now = self.clock()
+        if prefill:
+            ttft = None
+            if req.first_token_t is None:
+                req.first_token_t = now
+                ttft = now - req.arrival_t
+            self.metrics.record_prefill(now, ttft)
+        if self.sched.check_finished(req, now):
+            self.metrics.record_finish(req, now)
+
+
+class ServingEngine(_ServingBase):
+    """Continuous batching with the slot-based paged KV cache (module
+    docstring has the architecture)."""
+
+    def __init__(self, cfg: GPTConfig, params,
+                 serving_config: Union[ServingConfig, dict, None] = None,
+                 clock=time.monotonic, monitor=None):
+        scfg = (serving_config if isinstance(serving_config, ServingConfig)
+                else ServingConfig.from_dict(serving_config))
+        if not cfg.rotary and scfg.max_seq_len > cfg.max_seq:
+            raise ValueError(
+                f"serving max_seq_len ({scfg.max_seq_len}) exceeds the "
+                f"model's learned-position table ({cfg.max_seq})"
+            )
+        self.cfg = cfg
+        self.params = params
+        self.kv = PagedKVCache(cfg, scfg)
+        super().__init__(scfg, Scheduler(scfg, self.kv.allocator, clock),
+                         clock, monitor)
+        self._decode_step = make_decode_step(cfg, scfg)
+        # retraces once per prefill bucket (toks.shape[1] varies)
+        self._prefill_step = jax.jit(
+            lambda params, toks: apply_with_cache(
+                cfg, params, toks,
+                init_cache(cfg, toks.shape[0], toks.shape[1]), 0))
+        self._key = jax.random.PRNGKey(scfg.seed)
+
+    # compile counters (tests assert decode compiles exactly once)
+    @property
+    def decode_compile_count(self) -> int:
+        return getattr(self._decode_step, "_cache_size", lambda: -1)()
+
+    @property
+    def prefill_compile_count(self) -> int:
+        return getattr(self._prefill_step, "_cache_size", lambda: -1)()
+
+    def _next_key(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def _pick_token(self, logits_1d, req: Request) -> int:
+        """Prefill-time next-token selection (one request, host-driven).
+        Greedy path is the same raw argmax make_generator uses."""
+        if req.temperature <= 0.0:
+            return int(jnp.argmax(logits_1d))
+        top_k = self.scfg.top_k
+        if top_k is not None and top_k >= self.cfg.vocab_size:
+            top_k = None
+        filtered = prep_sampling_logits(logits_1d[None], req.temperature,
+                                        top_k)
+        return int(jax.random.categorical(self._next_key(), filtered,
+                                          axis=-1)[0])
+
+    def _admit_one(self, slot: int, req: Request, blocks: List[int]) -> None:
+        """Length-bucketed prefill of the request's context into its
+        allocated blocks; emits the request's next token."""
+        timer = self.metrics.timers(PREFILL_TIMER)
+        timer.safe_start()
+        ctx = req.context
+        L = len(ctx)
+        bucket = self.scfg.bucket_for(L)
+        toks = np.zeros((1, bucket), np.int32)
+        toks[0, :L] = ctx
+        logits, cache = self._prefill_step(self.params, jnp.asarray(toks))
+        # admission allocated headroom for the first decode write; only
+        # the context's own pages carry prefill data
+        data_blocks = blocks[:blocks_needed(L, self.scfg.block_size)]
+        self.kv.write_prefill(cache["k"], cache["v"], data_blocks, L)
+        tok = self._pick_token(logits[0, L - 1], req)
+        req.generated.append(tok)
+        timer.stop(sync_with=self.kv.k)
+        logger.debug("serving: admitted %s to slot %d (ctx=%d bucket=%d)",
+                     req.rid, slot, L, bucket)
+        self._record_emitted(req, prefill=True)
+
+    def _decode_all(self) -> None:
+        """One jitted decode step over the full slot array."""
+        N = self.scfg.num_slots
+        tables = np.zeros((N, self.scfg.blocks_per_slot), np.int32)
+        lengths = np.zeros(N, np.int32)
+        tokens = np.zeros(N, np.int32)
+        temps = np.zeros(N, np.float32)
+        active = []
+        for s, req in enumerate(self.sched.slots):
+            if req is None:
+                continue
+            active.append((s, req))
+            tables[s] = self.sched.slot_table_row(s)
+            lengths[s] = req.cached_len
+            tokens[s] = req.pending_token
+            temps[s] = req.temperature
+        timer = self.metrics.timers(DECODE_TIMER)
+        timer.safe_start()
+        nxt, self.kv.k, self.kv.v = self._decode_step(
+            self.params, self.kv.k, self.kv.v, jnp.asarray(tables),
+            jnp.asarray(lengths), jnp.asarray(tokens), jnp.asarray(temps),
+            self._next_key())
+        nxt = np.asarray(nxt)                       # device sync
+        timer.stop()
+        self.metrics.record_decode_step(len(active), len(self.sched.queue),
+                                        self.clock())
+        for s, req in active:
+            req.cached_len += 1
+            req.generated.append(int(nxt[s]))
+            self._record_emitted(req, prefill=False)
+
+
+# ------------------------------------------------------------------ #
+# pipelined-model bridge
+# ------------------------------------------------------------------ #
+
+
+class PipelineServingBridge(_ServingBase):
+    """The same submit/step/run surface for models served through a
+    full-prefix logits function — in particular a pipelined model's
+    ``PipelineEngine.inference_batch`` (the reference's per-token
+    recompute serving mode).
+
+    ``logits_fn(tokens (1, S) int32) -> logits (1, S, V)`` runs once per
+    active request per step (pipelined stages can't batch mixed-length
+    prefixes without an attention mask), so this path is for
+    compatibility, not throughput; the paged ServingEngine is the fast
+    path for non-pipelined models.
+    """
+
+    def __init__(self, logits_fn,
+                 serving_config: Union[ServingConfig, dict, None] = None,
+                 clock=time.monotonic, monitor=None):
+        scfg = (serving_config if isinstance(serving_config, ServingConfig)
+                else ServingConfig.from_dict(serving_config))
+        self.logits_fn = logits_fn
+        # no KV pool: a throwaway allocator sized so block accounting
+        # never backpressures — slots are the only admission limit here
+        from .kv_cache import BlockAllocator
+
+        alloc = BlockAllocator(1 + scfg.num_slots * scfg.blocks_per_slot)
+        super().__init__(scfg, Scheduler(scfg, alloc, clock), clock,
+                         monitor)
+        self._key = jax.random.PRNGKey(scfg.seed)
+
+    @classmethod
+    def from_pipeline_engine(cls, engine, serving_config=None, **kw):
+        """Serve a PipelineEngine (see runtime/pipe/engine.py
+        ``serving_logits_fn``)."""
+        return cls(engine.serving_logits_fn(), serving_config, **kw)
+
+    def _pick(self, logits_1d, req: Request) -> int:
+        if req.temperature <= 0.0:
+            return int(np.asarray(jnp.argmax(logits_1d)))
+        top_k = self.scfg.top_k
+        filtered = prep_sampling_logits(jnp.asarray(logits_1d)[None],
+                                        req.temperature, top_k)
+        self._key, sub = jax.random.split(self._key)
+        return int(jax.random.categorical(sub, filtered, axis=-1)[0])
+
+    def _emit_next(self, req: Request, prefill: bool) -> None:
+        ctx = np.asarray(req.context, np.int32)[None]
+        logits = self.logits_fn(ctx)
+        req.generated.append(self._pick(logits[0, -1], req))
+        req.cached_len = ctx.shape[1]   # bookkeeping only (no real cache)
+        self._record_emitted(req, prefill=prefill)
+
+    def _admit_one(self, slot: int, req: Request, blocks) -> None:
+        timer = self.metrics.timers(PREFILL_TIMER)
+        timer.safe_start()
+        self._emit_next(req, prefill=True)
+        timer.stop()
+
+    def _decode_all(self) -> None:
+        timer = self.metrics.timers(DECODE_TIMER)
+        timer.safe_start()
+        active = list(self.sched.active)
+        for req in active:
+            self._emit_next(req, prefill=False)
+        timer.stop()
+        self.metrics.record_decode_step(len(active),
+                                        len(self.sched.queue),
+                                        self.clock())

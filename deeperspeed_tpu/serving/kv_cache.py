@@ -1,0 +1,185 @@
+"""Slot-based paged KV cache: block pool, allocator, and the paged
+attention/cache-write math for the serving decode step.
+
+Layout: one pool per cache side, stacked over layers —
+
+    k, v: (n_layer, num_blocks, block_size, n_kv_head, head_dim)
+
+A request's cache lives in whichever blocks the allocator hands it; the
+per-slot BLOCK TABLE (``(num_slots, blocks_per_slot)`` int32) maps the
+request's logical block ``i`` to its physical block. Block 0 is the
+reserved NULL block: idle slots' tables and padded table entries point at
+it, so the fully static decode step can scatter/gather unconditionally —
+garbage lands in (or comes from) block 0 and is masked out by the
+per-slot length.
+
+Writes are static-shape updates into slot pages: prefill scatters whole
+``block_size`` pages (the dense prefill cache reshaped to pages, indexed
+by the allocated block list), decode scatters each slot's single new
+(K, V) row at ``(block_table[len // bs], len % bs)``. Reads gather the
+slot's pages back into a contiguous ``blocks_per_slot * block_size``
+view per layer — the XLA-gather formulation of paged attention; a Pallas
+kernel that walks the table in HBM without materializing the view is the
+planned TPU fast path (see docs/tutorials/serving.md).
+"""
+
+import math
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..models.gpt import GPTConfig
+from .config import ServingConfig
+
+NULL_BLOCK = 0
+
+
+class OutOfBlocks(Exception):
+    """Raised only for internal invariant violations — normal exhaustion
+    returns None from alloc() (backpressure, not an error)."""
+
+
+class BlockAllocator:
+    """Free-list allocator over the physical blocks of the KV pool.
+
+    Block 0 (NULL_BLOCK) is never handed out. alloc() is all-or-nothing:
+    a request that cannot get every block it asked for gets none, and the
+    caller leaves it queued (backpressure) or preempts a victim.
+    """
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 2:
+            raise ValueError("need >= 2 blocks (block 0 is reserved)")
+        self.num_blocks = num_blocks
+        # LIFO free list: recently freed (cache-warm) blocks reused first
+        self._free: List[int] = list(range(num_blocks - 1, 0, -1))
+        self._allocated = set()
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_allocated(self) -> int:
+        return len(self._allocated)
+
+    def can_alloc(self, n: int) -> bool:
+        return n <= len(self._free)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """n blocks, or None when the pool cannot satisfy the request."""
+        if n < 0:
+            raise ValueError(f"cannot alloc {n} blocks")
+        if n > len(self._free):
+            return None
+        blocks = [self._free.pop() for _ in range(n)]
+        self._allocated.update(blocks)
+        return blocks
+
+    def free(self, blocks: List[int]) -> None:
+        for b in blocks:
+            if b not in self._allocated:
+                raise OutOfBlocks(
+                    f"double free / foreign free of block {b} "
+                    f"(allocated={sorted(self._allocated)})"
+                )
+            self._allocated.remove(b)
+            self._free.append(b)
+
+
+def blocks_needed(n_tokens: int, block_size: int) -> int:
+    return math.ceil(n_tokens / block_size) if n_tokens > 0 else 0
+
+
+class PagedKVCache:
+    """The device-side block pool plus its host-side allocator.
+
+    ``k``/``v`` are replaced wholesale by the jitted prefill-write and
+    decode steps (which donate the old pools); this object owns the
+    handles and the block accounting.
+    """
+
+    def __init__(self, cfg: GPTConfig, scfg: ServingConfig):
+        self.cfg = cfg
+        self.scfg = scfg
+        shape = (cfg.n_layer, scfg.num_blocks, scfg.block_size,
+                 cfg.kv_heads, cfg.head_dim)
+        self.k = jnp.zeros(shape, cfg.dtype)
+        self.v = jnp.zeros(shape, cfg.dtype)
+        self.allocator = BlockAllocator(scfg.num_blocks)
+        self._write_prefill = jax.jit(_scatter_prefill_pages,
+                                      donate_argnums=(0, 1))
+
+    def write_prefill(self, k_dense, v_dense, blocks: List[int],
+                      length: int) -> None:
+        """Scatter a dense prefill cache (L, 1, bucket, Hkv, Dh) into the
+        allocated ``blocks``. ``bucket`` is a multiple of block_size;
+        pages beyond ``blocks`` (prompt padding) go to the null block."""
+        bs = self.scfg.block_size
+        bucket = k_dense.shape[2]
+        assert bucket % bs == 0, (bucket, bs)
+        n_pages = bucket // bs
+        assert len(blocks) == blocks_needed(length, bs), (blocks, length)
+        idx = jnp.asarray(
+            list(blocks) + [NULL_BLOCK] * (n_pages - len(blocks)),
+            jnp.int32,
+        )
+        self.k, self.v = self._write_prefill(self.k, self.v, k_dense,
+                                             v_dense, idx)
+
+
+def _scatter_prefill_pages(k_pool, v_pool, k_dense, v_dense, idx):
+    """(L, 1, bucket, Hkv, Dh) dense prefill cache -> pool pages at idx."""
+    L, _, bucket, Hkv, Dh = k_dense.shape
+    bs = k_pool.shape[2]
+    pages_k = k_dense.reshape(L, bucket // bs, bs, Hkv, Dh)
+    pages_v = v_dense.reshape(L, bucket // bs, bs, Hkv, Dh)
+    # duplicate null-block targets (padding pages) may race; block 0's
+    # content is never read unmasked, so last-writer-wins is fine
+    return (k_pool.at[:, idx].set(pages_k.astype(k_pool.dtype)),
+            v_pool.at[:, idx].set(pages_v.astype(v_pool.dtype)))
+
+
+def paged_attend(k_pool_l, v_pool_l, q, k_new, v_new, tables, lengths,
+                 write_block, write_off):
+    """One layer of single-token paged-cache attention for all slots.
+
+    k_pool_l/v_pool_l: (num_blocks, bs, Hkv, Dh) — this layer's pool.
+    q: (N, 1, H, Dh); k_new/v_new: (N, 1, Hkv, Dh) — the new token's
+    projections per slot. tables: (N, blocks_per_slot) int32; lengths:
+    (N,) tokens already cached per slot; write_block/write_off: (N,)
+    physical block + in-block offset for the new row.
+
+    Returns (ctx (N, 1, H, Dh), k_pool_l', v_pool_l'). Mirrors
+    models/generation._cached_block's grouped-einsum math (GQA reads at
+    the small Hkv width) so greedy serving outputs are token-identical to
+    make_generator's.
+    """
+    N = q.shape[0]
+    Hq, Dh = q.shape[2], q.shape[3]
+    cdt = k_pool_l.dtype
+    # write the new row: idle slots target (null block, 0) by construction
+    k_pool_l = k_pool_l.at[write_block, write_off].set(
+        k_new[:, 0].astype(cdt))
+    v_pool_l = v_pool_l.at[write_block, write_off].set(
+        v_new[:, 0].astype(cdt))
+    # gather each slot's pages into a contiguous logical view
+    bs = k_pool_l.shape[1]
+    view = tables.shape[1] * bs
+    k_c = k_pool_l[tables].reshape(N, view, k_pool_l.shape[2], Dh)
+    v_c = v_pool_l[tables].reshape(N, view, v_pool_l.shape[2], Dh)
+    Hkv = k_c.shape[2]
+    rep = Hq // Hkv
+    qg = q.reshape(N, 1, Hkv, rep, Dh)
+    scores = jnp.einsum("bqhrd,bkhd->bhrqk", qg, k_c,
+                        preferred_element_type=jnp.float32)
+    scores = scores / math.sqrt(Dh)
+    # valid keys: logical positions 0..length inclusive (the row written
+    # above sits at position == length)
+    key_pos = jnp.arange(view, dtype=jnp.int32)
+    valid = key_pos[None, :] <= lengths[:, None]          # (N, view)
+    scores = jnp.where(valid[:, None, None, None, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    ctx = jnp.einsum("bhrqk,bkhd->bqhrd", probs, v_c)
+    return ctx.reshape(N, 1, Hq, Dh), k_pool_l, v_pool_l

@@ -1,0 +1,141 @@
+"""Serving metrics: per-request TTFT/TPOT, queue depth, slot occupancy,
+tokens/s.
+
+Collection is host-side and allocation-light (floats appended to lists);
+export goes through the same surfaces the training engine uses —
+``utils/timer.SynchronizedWallClockTimer`` for the prefill/decode wall
+clocks and ``utils/tensorboard.TensorBoardMonitor`` for scalar series —
+so serving shows up in the exact dashboards training already feeds.
+"""
+
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..utils.tensorboard import TensorBoardMonitor
+from ..utils.timer import SynchronizedWallClockTimer
+
+# timer names (appear in SynchronizedWallClockTimer.log output)
+PREFILL_TIMER = "serving/prefill"
+DECODE_TIMER = "serving/decode"
+
+
+def _percentiles(xs: List[float]) -> Dict[str, float]:
+    if not xs:
+        return {"p50": 0.0, "p99": 0.0, "mean": 0.0, "max": 0.0}
+    a = np.asarray(xs, np.float64)
+    return {
+        "p50": float(np.percentile(a, 50)),
+        "p99": float(np.percentile(a, 99)),
+        "mean": float(a.mean()),
+        "max": float(a.max()),
+    }
+
+
+class ServingMetrics:
+    def __init__(self, num_slots: int,
+                 clock: Callable[[], float] = time.monotonic,
+                 monitor: Optional[TensorBoardMonitor] = None):
+        self.num_slots = num_slots
+        self.clock = clock
+        self.monitor = monitor
+        self.timers = SynchronizedWallClockTimer()
+        self.ttft_s: List[float] = []
+        self.tpot_s: List[float] = []
+        self.queue_depth: List[int] = []
+        self.occupancy: List[float] = []
+        self.total_generated = 0
+        self.decode_steps = 0
+        self.prefills = 0
+        self.preemptions = 0
+        self.finished: Dict[str, int] = {}
+        self._start_t: Optional[float] = None
+        self._end_t: Optional[float] = None
+
+    # ------------------------------------------------------------ #
+    # recording
+    # ------------------------------------------------------------ #
+
+    def record_prefill(self, now: float,
+                       ttft_s: Optional[float] = None) -> None:
+        """One prefill (it emits one token). ttft_s is set only for a
+        request's FIRST admission — preemption re-prefills don't re-count
+        time-to-first-token."""
+        if self._start_t is None:
+            self._start_t = now
+        self.prefills += 1
+        self.total_generated += 1
+        if ttft_s is not None:
+            self.ttft_s.append(ttft_s)
+        self._end_t = now
+
+    def record_decode_step(self, n_active: int, queue_depth: int,
+                           now: float) -> None:
+        if self._start_t is None:
+            self._start_t = now
+        self.decode_steps += 1
+        self.total_generated += n_active
+        self.queue_depth.append(queue_depth)
+        self.occupancy.append(n_active / self.num_slots)
+        self._end_t = now
+
+    def record_preemption(self) -> None:
+        self.preemptions += 1
+
+    def record_finish(self, req, now: float) -> None:
+        self.finished[req.finish_reason] = (
+            self.finished.get(req.finish_reason, 0) + 1)
+        self._end_t = now
+        n = len(req.generated)
+        if n > 1 and req.first_token_t is not None:
+            self.tpot_s.append((now - req.first_token_t) / (n - 1))
+
+    # ------------------------------------------------------------ #
+    # reporting
+    # ------------------------------------------------------------ #
+
+    @property
+    def elapsed_s(self) -> float:
+        if self._start_t is None or self._end_t is None:
+            return 0.0
+        return max(self._end_t - self._start_t, 1e-9)
+
+    def summary(self) -> Dict:
+        occ = np.asarray(self.occupancy, np.float64)
+        return {
+            "requests_finished": int(sum(self.finished.values())),
+            "finish_reasons": dict(self.finished),
+            "tokens_generated": int(self.total_generated),
+            "decode_steps": int(self.decode_steps),
+            "prefills": int(self.prefills),
+            "preemptions": int(self.preemptions),
+            "elapsed_s": self.elapsed_s,
+            "tokens_per_sec": self.total_generated / self.elapsed_s
+            if self.elapsed_s else 0.0,
+            "ttft_s": _percentiles(self.ttft_s),
+            "tpot_s": _percentiles(self.tpot_s),
+            "slot_occupancy": float(occ.mean()) if occ.size else 0.0,
+            "queue_depth_max": int(max(self.queue_depth, default=0)),
+        }
+
+    def export(self, step: int) -> None:
+        """Push the running summary to the TensorBoard monitor (JSONL
+        fallback included — see utils/tensorboard.py)."""
+        if self.monitor is None:
+            return
+        s = self.summary()
+        self.monitor.write_scalars(
+            {
+                "Serving/tokens_per_sec": s["tokens_per_sec"],
+                "Serving/ttft_p50_s": s["ttft_s"]["p50"],
+                "Serving/ttft_p99_s": s["ttft_s"]["p99"],
+                "Serving/tpot_p50_s": s["tpot_s"]["p50"],
+                "Serving/tpot_p99_s": s["tpot_s"]["p99"],
+                "Serving/slot_occupancy": s["slot_occupancy"],
+                "Serving/queue_depth": float(
+                    self.queue_depth[-1] if self.queue_depth else 0),
+                "Serving/preemptions": float(self.preemptions),
+            },
+            step,
+        )

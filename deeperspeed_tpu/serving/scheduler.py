@@ -1,0 +1,268 @@
+"""Continuous-batching scheduler: admission, slot + block accounting,
+eviction, preemption, backpressure.
+
+Policy (deliberately simple and deterministic — the decode step is where
+the hardware time goes, and a deterministic scheduler is what makes the
+greedy-parity test meaningful):
+
+  * FIFO admission with head-of-line blocking: the queue head is admitted
+    when a slot is free AND the allocator can cover its context plus one
+    decode write; otherwise admission stops (backpressure — the request
+    STAYS QUEUED, nothing crashes).
+  * Blocks are allocated incrementally: admission covers the prompt, and
+    each time a slot's next write would cross a block boundary the
+    scheduler allocates one more block. No request ever reserves
+    max_seq_len worth of cache up front.
+  * When the pool cannot cover a mid-decode extension, the MOST RECENTLY
+    admitted slot is preempted: its blocks are freed and the request goes
+    back to the FRONT of the queue carrying its generated tokens, so
+    re-admission prefills prompt+generated and continues exactly where it
+    left off (token-identical for greedy; sampling resumes with fresh
+    keys).
+  * Eviction on EOS, on exhausting max_new_tokens, and on
+    request_timeout_s (queued or running; partial output is kept).
+"""
+
+import dataclasses
+import itertools
+import time
+from collections import deque
+from typing import Callable, Deque, List, Optional
+
+from ..utils.logging import logger
+from .config import ServingConfig
+from .kv_cache import NULL_BLOCK, BlockAllocator, blocks_needed
+
+QUEUED = "queued"
+ACTIVE = "active"
+FINISHED = "finished"
+
+FINISH_LENGTH = "length"      # exhausted max_new_tokens
+FINISH_EOS = "eos"
+FINISH_TIMEOUT = "timeout"
+
+
+@dataclasses.dataclass
+class Request:
+    rid: str
+    prompt: List[int]
+    max_new_tokens: int
+    temperature: float = 0.0
+    arrival_t: float = 0.0
+    # -- runtime state --
+    state: str = QUEUED
+    generated: List[int] = dataclasses.field(default_factory=list)
+    slot: int = -1
+    cached_len: int = 0           # tokens whose KV is written to the pool
+    admissions: int = 0           # 1 + number of preemption re-admissions
+    first_token_t: Optional[float] = None
+    finish_t: Optional[float] = None
+    finish_reason: Optional[str] = None
+
+    @property
+    def context(self) -> List[int]:
+        """Tokens to prefill on (re)admission: the original prompt plus
+        anything generated before a preemption."""
+        return self.prompt + self.generated
+
+    @property
+    def remaining(self) -> int:
+        return self.max_new_tokens - len(self.generated)
+
+    @property
+    def pending_token(self) -> int:
+        """The last generated token — fed to the next decode step, whose
+        KV row is not yet in the pool."""
+        return self.generated[-1]
+
+    @property
+    def output(self) -> List[int]:
+        return list(self.generated)
+
+
+class Scheduler:
+    """Owns the slot array, the per-slot block lists, and the queue."""
+
+    def __init__(self, scfg: ServingConfig, allocator: BlockAllocator,
+                 clock: Callable[[], float] = time.monotonic):
+        self.scfg = scfg
+        self.allocator = allocator
+        self.clock = clock
+        self.queue: Deque[Request] = deque()
+        self.slots: List[Optional[Request]] = [None] * scfg.num_slots
+        self.slot_blocks: List[List[int]] = [[] for _ in range(scfg.num_slots)]
+        self._admit_seq = itertools.count()   # admission order, for victims
+        self._slot_admitted_at = [-1] * scfg.num_slots
+        self.finished: List[Request] = []
+
+    # ---------------------------------------------------------------- #
+    # queue / admission
+    # ---------------------------------------------------------------- #
+
+    def submit(self, req: Request) -> None:
+        if not req.prompt:
+            raise ValueError(f"request {req.rid}: empty prompt")
+        if req.max_new_tokens < 1:
+            raise ValueError(
+                f"request {req.rid}: max_new_tokens must be >= 1"
+            )
+        ctx_cap = len(req.prompt) + req.max_new_tokens
+        if ctx_cap > self.scfg.max_seq_len:
+            raise ValueError(
+                f"request {req.rid}: prompt ({len(req.prompt)}) + "
+                f"max_new_tokens ({req.max_new_tokens}) = {ctx_cap} exceeds "
+                f"max_seq_len ({self.scfg.max_seq_len})"
+            )
+        # worst-case footprint (full context + one decode-write of
+        # headroom) must fit an EMPTY pool, else the request could never
+        # admit and the engine would spin forever on backpressure
+        worst = blocks_needed(ctx_cap, self.scfg.block_size)
+        if worst > self.allocator.num_blocks - 1:
+            raise ValueError(
+                f"request {req.rid}: worst-case footprint ({worst} blocks "
+                f"of {self.scfg.block_size}) exceeds the pool "
+                f"({self.allocator.num_blocks - 1} usable blocks); raise "
+                f"num_blocks or lower max_new_tokens"
+            )
+        self.queue.append(req)
+
+    @property
+    def num_active(self) -> int:
+        return sum(r is not None for r in self.slots)
+
+    @property
+    def active(self) -> List[Request]:
+        return [r for r in self.slots if r is not None]
+
+    def has_work(self) -> bool:
+        return bool(self.queue) or self.num_active > 0
+
+    def pop_admissible(self):
+        """(slot, request, blocks) for the queue head, or None when no
+        slot is free / the pool cannot cover its context + one decode
+        write (backpressure: the head stays queued)."""
+        if not self.queue:
+            return None
+        try:
+            slot = self.slots.index(None)
+        except ValueError:
+            return None
+        req = self.queue[0]
+        # +1: headroom for the first decode write, so a freshly admitted
+        # request cannot be preempted before its first step
+        need = blocks_needed(len(req.context) + 1, self.scfg.block_size)
+        blocks = self.allocator.alloc(need)
+        if blocks is None:
+            return None
+        self.queue.popleft()
+        req.state = ACTIVE
+        req.slot = slot
+        req.cached_len = len(req.context)
+        req.admissions += 1
+        self.slots[slot] = req
+        self.slot_blocks[slot] = blocks
+        self._slot_admitted_at[slot] = next(self._admit_seq)
+        return slot, req, blocks
+
+    # ---------------------------------------------------------------- #
+    # decode-time capacity
+    # ---------------------------------------------------------------- #
+
+    def ensure_decode_capacity(self) -> List[Request]:
+        """Grow each active slot's block list to cover its next write;
+        preempt most-recently-admitted slots when the pool runs dry.
+        Returns the preempted requests (already requeued)."""
+        preempted: List[Request] = []
+        for slot in range(self.scfg.num_slots):
+            while True:
+                req = self.slots[slot]
+                if req is None:
+                    break
+                need = blocks_needed(req.cached_len + 1,
+                                     self.scfg.block_size)
+                short = need - len(self.slot_blocks[slot])
+                if short <= 0:
+                    break
+                extra = self.allocator.alloc(short)
+                if extra is not None:
+                    self.slot_blocks[slot].extend(extra)
+                    break
+                victim = self._preempt_victim()
+                preempted.append(self._preempt(victim))
+                # if we preempted THIS slot, the inner while re-checks and
+                # finds it empty; otherwise retry the alloc
+        return preempted
+
+    def _preempt_victim(self) -> int:
+        victims = [s for s in range(self.scfg.num_slots)
+                   if self.slots[s] is not None]
+        assert victims, "ensure_decode_capacity with no active slots"
+        return max(victims, key=lambda s: self._slot_admitted_at[s])
+
+    def _preempt(self, slot: int) -> Request:
+        req = self.slots[slot]
+        logger.info(
+            "serving: preempting request %s from slot %d (%d blocks freed)",
+            req.rid, slot, len(self.slot_blocks[slot]),
+        )
+        self._release_slot(slot)
+        req.state = QUEUED
+        req.slot = -1
+        req.cached_len = 0
+        self.queue.appendleft(req)
+        return req
+
+    # ---------------------------------------------------------------- #
+    # eviction
+    # ---------------------------------------------------------------- #
+
+    def _release_slot(self, slot: int) -> None:
+        self.allocator.free(self.slot_blocks[slot])
+        self.slot_blocks[slot] = []
+        self.slots[slot] = None
+        self._slot_admitted_at[slot] = -1
+
+    def finish(self, req: Request, reason: str,
+               now: Optional[float] = None) -> None:
+        if req.state == ACTIVE:
+            self._release_slot(req.slot)
+        elif req.state == QUEUED:
+            self.queue.remove(req)
+        req.state = FINISHED
+        req.slot = -1
+        req.finish_reason = reason
+        req.finish_t = self.clock() if now is None else now
+        self.finished.append(req)
+
+    def check_finished(self, req: Request,
+                       now: Optional[float] = None) -> bool:
+        """Finish ``req`` if its last generated token ends it."""
+        eos = self.scfg.eos_token_id
+        if eos is not None and req.generated and req.pending_token == eos:
+            self.finish(req, FINISH_EOS, now)
+            return True
+        if req.remaining <= 0:
+            self.finish(req, FINISH_LENGTH, now)
+            return True
+        return False
+
+    def expire_timeouts(self, now: float) -> List[Request]:
+        """Evict queued AND active requests older than request_timeout_s."""
+        timeout = self.scfg.request_timeout_s
+        if timeout is None:
+            return []
+        expired = [r for r in list(self.queue) + self.active
+                   if now - r.arrival_t >= timeout]
+        for r in expired:
+            self.finish(r, FINISH_TIMEOUT, now)
+        return expired
+
+    # ---------------------------------------------------------------- #
+    # decode-step views
+    # ---------------------------------------------------------------- #
+
+    def slot_table_row(self, slot: int) -> List[int]:
+        blocks = self.slot_blocks[slot]
+        pad = self.scfg.blocks_per_slot - len(blocks)
+        assert pad >= 0, (slot, blocks)
+        return blocks + [NULL_BLOCK] * pad

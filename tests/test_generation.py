@@ -93,3 +93,85 @@ def test_generate_single_token_and_sampling():
     np.testing.assert_array_equal(a, b)
     assert not np.array_equal(a, c)
     assert ((a[:, 4:] >= 0) & (a[:, 4:] < 97)).all()
+
+
+# ------------------------------------------------------------------ #
+# sampling edges the serving engine exercises (serving/engine.py)
+# ------------------------------------------------------------------ #
+
+
+def test_temperature_zero_is_greedy_and_rng_independent():
+    """temperature=0 must be deterministic argmax regardless of the rng
+    passed — serving relies on this to mix greedy and sampled slots in
+    one decode step."""
+    cfg = _cfg()
+    init_fn, _, _, _ = make_gpt(cfg)
+    params = init_fn(jax.random.PRNGKey(0))
+    prompt = jnp.asarray(np.random.RandomState(5).randint(0, 97, (2, 6)))
+    gen = make_generator(cfg)
+    a = np.asarray(gen(params, prompt, max_new_tokens=10, temperature=0.0,
+                       rng=jax.random.PRNGKey(1)))
+    b = np.asarray(gen(params, prompt, max_new_tokens=10, temperature=0.0,
+                       rng=jax.random.PRNGKey(99)))
+    c = np.asarray(gen(params, prompt, max_new_tokens=10))  # default rng
+    np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(a, c)
+
+
+def test_top_k_at_least_vocab_matches_unfiltered():
+    """top_k >= vocab_size filters nothing: same rng must give the same
+    sample as top_k=None (the serving config treats it as None)."""
+    cfg = _cfg()
+    init_fn, _, _, _ = make_gpt(cfg)
+    params = init_fn(jax.random.PRNGKey(0))
+    prompt = jnp.asarray(np.random.RandomState(6).randint(0, 97, (1, 5)))
+    gen = make_generator(cfg)
+    key = jax.random.PRNGKey(3)
+    full = np.asarray(gen(params, prompt, max_new_tokens=12, temperature=0.8,
+                          top_k=97, rng=key))
+    none = np.asarray(gen(params, prompt, max_new_tokens=12, temperature=0.8,
+                          top_k=None, rng=key))
+    over = np.asarray(gen(params, prompt, max_new_tokens=12, temperature=0.8,
+                          top_k=97, rng=key))
+    np.testing.assert_array_equal(full, none)
+    np.testing.assert_array_equal(full, over)
+
+
+def test_mixed_prompt_lengths_left_padding_batch():
+    """A left-padded mixed-length batch: rows are independent lanes, so
+    the full-length row must generate exactly what it generates alone
+    (this is the slot-independence property serving builds on). The
+    padded row's continuation differs from its unpadded solo decode —
+    make_generator has no attention mask, pads ARE context; the serving
+    engine is the padless path for mixed lengths."""
+    cfg = _cfg()
+    init_fn, _, _, _ = make_gpt(cfg)
+    params = init_fn(jax.random.PRNGKey(0))
+    rs = np.random.RandomState(7)
+    short, long_ = rs.randint(0, 97, (4,)), rs.randint(0, 97, (9,))
+    batch = np.zeros((2, 9), np.int32)
+    batch[0, 9 - 4:] = short          # left-padded with token 0
+    batch[1] = long_
+    gen = make_generator(cfg)
+    out = np.asarray(gen(params, jnp.asarray(batch), max_new_tokens=7))
+    solo_long = np.asarray(
+        gen(params, jnp.asarray(long_[None]), max_new_tokens=7))
+    np.testing.assert_array_equal(out[1], solo_long[0])
+    # prompts survive verbatim in both rows
+    np.testing.assert_array_equal(out[:, :9], batch)
+    # determinism across calls for the whole padded batch
+    out2 = np.asarray(gen(params, jnp.asarray(batch), max_new_tokens=7))
+    np.testing.assert_array_equal(out, out2)
+
+
+def test_max_new_tokens_zero_rejected():
+    """max_new_tokens=0 raises rather than silently returning the prompt
+    (the scan body would run length -1); serving validates the same edge
+    at submit()."""
+    cfg = _cfg()
+    init_fn, _, _, _ = make_gpt(cfg)
+    params = init_fn(jax.random.PRNGKey(0))
+    prompt = jnp.asarray(np.random.RandomState(8).randint(0, 97, (1, 4)))
+    gen = make_generator(cfg)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        gen(params, prompt, max_new_tokens=0)

@@ -1,0 +1,376 @@
+"""ServingEngine end-to-end tests: continuous-batching greedy parity with
+make_generator under staggered arrivals (decode compiling exactly once),
+backpressure, preemption, EOS/length/timeout eviction, metrics accounting,
+the pipeline bridge, and the TrainingConfig "serving" block."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeperspeed_tpu.models.generation import make_generator
+from deeperspeed_tpu.models.gpt import GPTConfig, make_gpt
+from deeperspeed_tpu.runtime.config import ConfigError, TrainingConfig
+from deeperspeed_tpu.serving import (
+    FINISH_EOS,
+    FINISH_LENGTH,
+    FINISH_TIMEOUT,
+    PipelineServingBridge,
+    ServingConfig,
+    ServingEngine,
+)
+
+
+def _cfg(**kw):
+    d = dict(vocab_size=97, n_layer=2, n_head=2, d_model=32, max_seq=64,
+             remat=False, dtype=jnp.float32, attn_impl="xla")
+    d.update(kw)
+    return GPTConfig(**d)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = _cfg()
+    init_fn, apply_fn, _, _ = make_gpt(cfg)
+    params = init_fn(jax.random.PRNGKey(0))
+    return cfg, params, apply_fn
+
+
+def _ref_outputs(cfg, params, prompts, max_news):
+    """Per-request greedy continuations via make_generator (the oracle the
+    acceptance criterion names)."""
+    gen = make_generator(cfg)
+    refs = []
+    for p, m in zip(prompts, max_news):
+        out = np.asarray(gen(params, jnp.asarray(np.asarray(p)[None]),
+                             max_new_tokens=m))
+        refs.append(out[0, len(p):].tolist())
+    return refs
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+# ------------------------------------------------------------------ #
+# the core acceptance criterion
+# ------------------------------------------------------------------ #
+
+
+def test_staggered_arrivals_greedy_parity_compile_once(model):
+    """N requests with staggered arrivals and different prompt/output
+    lengths produce token-identical greedy outputs to per-request
+    make_generator calls, and the decode step compiles exactly once
+    across all admissions/evictions."""
+    cfg, params, _ = model
+    rs = np.random.RandomState(0)
+    lens = [3, 5, 7, 9, 6]
+    news = [6, 9, 4, 7, 5]
+    prompts = [rs.randint(0, 97, (n,)).tolist() for n in lens]
+    refs = _ref_outputs(cfg, params, prompts, news)
+
+    scfg = ServingConfig(num_slots=3, block_size=4, num_blocks=64,
+                         max_seq_len=48)
+    eng = ServingEngine(cfg, params, scfg)
+    rids = [eng.submit(prompts[i], max_new_tokens=news[i]) for i in (0, 1)]
+    eng.step()
+    eng.step()
+    rids += [eng.submit(prompts[i], max_new_tokens=news[i]) for i in (2, 3)]
+    eng.step()
+    rids.append(eng.submit(prompts[4], max_new_tokens=news[4]))
+    outs = eng.run()
+
+    assert len(outs) == 5
+    for rid, ref in zip(rids, refs):
+        np.testing.assert_array_equal(outs[rid], ref)
+        assert eng.get(rid).finish_reason == FINISH_LENGTH
+    assert eng.decode_compile_count == 1
+    # context lengths 3,5,7,9,6 hit buckets 4,8,8,16,8 -> three programs
+    assert eng.prefill_compile_count == 3
+
+
+def test_backpressure_blocks_exhausted_request_stays_queued(model):
+    """A request whose blocks aren't available stays QUEUED (no crash,
+    no admission) even while a slot is free, and still finishes correctly
+    once the pool drains."""
+    cfg, params, _ = model
+    rs = np.random.RandomState(1)
+    prompts = [rs.randint(0, 97, (8,)).tolist() for _ in range(3)]
+    refs = _ref_outputs(cfg, params, prompts, [8, 8, 8])
+
+    # 8 usable blocks of 4: two admissions take 3 each, the third's 3
+    # cannot be met -> head-of-line backpressure with a slot sitting free
+    scfg = ServingConfig(num_slots=3, block_size=4, num_blocks=9,
+                         max_seq_len=32)
+    eng = ServingEngine(cfg, params, scfg)
+    rids = [eng.submit(p, max_new_tokens=8) for p in prompts]
+    eng.step()
+    third = eng.get(rids[2])
+    assert third.state == "queued" and third.slot == -1
+    assert eng.sched.num_active == 2          # a slot IS free; blocks aren't
+    outs = eng.run()
+    for rid, ref in zip(rids, refs):
+        np.testing.assert_array_equal(outs[rid], ref)
+    assert eng.decode_compile_count == 1
+
+
+def test_preemption_under_contention_keeps_parity(model):
+    """When mid-decode block growth finds the pool dry, the youngest slot
+    is preempted and re-admitted later — outputs stay token-identical to
+    the per-request oracle."""
+    cfg, params, _ = model
+    rs = np.random.RandomState(2)
+    prompts = [rs.randint(0, 97, (n,)).tolist() for n in (7, 6, 5, 4)]
+    news = [10, 9, 11, 8]
+    refs = _ref_outputs(cfg, params, prompts, news)
+
+    scfg = ServingConfig(num_slots=4, block_size=4, num_blocks=8,
+                         max_seq_len=20)
+    eng = ServingEngine(cfg, params, scfg)
+    rids = [eng.submit(p, max_new_tokens=m) for p, m in zip(prompts, news)]
+    outs = eng.run()
+    assert eng.metrics.preemptions > 0        # contention actually happened
+    assert any(eng.get(r).admissions > 1 for r in rids)
+    for rid, ref in zip(rids, refs):
+        np.testing.assert_array_equal(outs[rid], ref)
+    assert eng.decode_compile_count == 1
+
+
+# ------------------------------------------------------------------ #
+# eviction paths
+# ------------------------------------------------------------------ #
+
+
+def test_eos_eviction_truncates_at_the_reference_token(model):
+    cfg, params, _ = model
+    prompt = np.random.RandomState(4).randint(0, 97, (6,)).tolist()
+    [ref] = _ref_outputs(cfg, params, [prompt], [12])
+    eos = ref[4]
+    expected = ref[:ref.index(eos) + 1]       # first occurrence wins
+
+    scfg = ServingConfig(num_slots=2, block_size=4, num_blocks=32,
+                         max_seq_len=32, eos_token_id=eos)
+    eng = ServingEngine(cfg, params, scfg)
+    rid = eng.submit(prompt, max_new_tokens=12)
+    outs = eng.run()
+    np.testing.assert_array_equal(outs[rid], expected)
+    assert eng.get(rid).finish_reason == FINISH_EOS
+
+
+def test_timeout_evicts_queued_and_active(model):
+    cfg, params, _ = model
+    clk = FakeClock()
+    scfg = ServingConfig(num_slots=1, block_size=4, num_blocks=32,
+                         max_seq_len=32, request_timeout_s=5.0)
+    eng = ServingEngine(cfg, params, scfg, clock=clk)
+    rs = np.random.RandomState(5)
+    active = eng.submit(rs.randint(0, 97, (4,)).tolist(), max_new_tokens=20)
+    queued = eng.submit(rs.randint(0, 97, (4,)).tolist(), max_new_tokens=20)
+    eng.step()                                 # admits `active` only
+    assert eng.get(active).state == "active"
+    clk.t = 6.0
+    done = eng.step()                          # both are now over budget
+    assert {r.rid for r in done} == {active, queued}
+    assert eng.get(active).finish_reason == FINISH_TIMEOUT
+    assert eng.get(queued).finish_reason == FINISH_TIMEOUT
+    assert len(eng.get(active).output) >= 1    # partial output is kept
+    assert eng.get(queued).output == []
+    assert not eng.has_work()
+    assert eng.kv.allocator.num_allocated == 0  # blocks all returned
+
+
+def test_max_new_tokens_one_finishes_at_prefill(model):
+    """A one-token request is satisfied entirely by prefill — the decode
+    step never runs (and so never compiles)."""
+    cfg, params, _ = model
+    prompt = np.random.RandomState(6).randint(0, 97, (5,)).tolist()
+    [ref] = _ref_outputs(cfg, params, [prompt], [1])
+    eng = ServingEngine(cfg, params,
+                        ServingConfig(num_slots=2, block_size=4,
+                                      num_blocks=32, max_seq_len=32))
+    rid = eng.submit(prompt, max_new_tokens=1)
+    outs = eng.run()
+    np.testing.assert_array_equal(outs[rid], ref)
+    assert eng.decode_compile_count == 0
+    assert eng.metrics.decode_steps == 0
+
+
+# ------------------------------------------------------------------ #
+# submit() validation
+# ------------------------------------------------------------------ #
+
+
+def test_submit_validation_errors(model):
+    cfg, params, _ = model
+    scfg = ServingConfig(num_slots=2, block_size=4, num_blocks=6,
+                         max_seq_len=32)
+    eng = ServingEngine(cfg, params, scfg)
+    with pytest.raises(ValueError, match="max_seq_len"):
+        eng.submit(list(range(30)), max_new_tokens=10)
+    with pytest.raises(ValueError, match="empty prompt"):
+        eng.submit([])
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        eng.submit([1, 2], max_new_tokens=0)
+    # fits max_seq_len but could never fit the 5-usable-block pool:
+    # rejected at submit, not left to spin on backpressure forever
+    with pytest.raises(ValueError, match="footprint"):
+        eng.submit(list(range(10)), max_new_tokens=16)
+    eng.submit([1, 2, 3], max_new_tokens=4, request_id="dup")
+    with pytest.raises(ValueError, match="duplicate"):
+        eng.submit([4, 5, 6], max_new_tokens=4, request_id="dup")
+
+
+def test_non_rotary_model_rejects_oversized_serving_window():
+    cfg = _cfg(rotary=False)
+    init_fn, _, _, _ = make_gpt(cfg)
+    params = init_fn(jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="learned-position"):
+        ServingEngine(cfg, params,
+                      ServingConfig(max_seq_len=128, num_blocks=32))
+
+
+# ------------------------------------------------------------------ #
+# sampling + metrics
+# ------------------------------------------------------------------ #
+
+
+def test_mixed_greedy_and_sampled_slots(model):
+    """A greedy request sharing decode steps with a sampled one must stay
+    token-identical to its solo oracle (per-slot temperature vector)."""
+    cfg, params, _ = model
+    rs = np.random.RandomState(7)
+    g_prompt = rs.randint(0, 97, (6,)).tolist()
+    s_prompt = rs.randint(0, 97, (5,)).tolist()
+    [ref] = _ref_outputs(cfg, params, [g_prompt], [10])
+
+    scfg = ServingConfig(num_slots=2, block_size=4, num_blocks=32,
+                         max_seq_len=32, top_k=20, seed=11)
+    eng = ServingEngine(cfg, params, scfg)
+    rg = eng.submit(g_prompt, max_new_tokens=10, temperature=0.0)
+    rsamp = eng.submit(s_prompt, max_new_tokens=10, temperature=1.0)
+    outs = eng.run()
+    np.testing.assert_array_equal(outs[rg], ref)
+    assert len(outs[rsamp]) == 10
+    assert all(0 <= t < 97 for t in outs[rsamp])
+
+
+def test_metrics_accounting(model):
+    cfg, params, _ = model
+    rs = np.random.RandomState(8)
+    prompts = [rs.randint(0, 97, (n,)).tolist() for n in (4, 6, 5)]
+    eng = ServingEngine(cfg, params,
+                        ServingConfig(num_slots=2, block_size=4,
+                                      num_blocks=32, max_seq_len=32))
+    rids = [eng.submit(p, max_new_tokens=6) for p in prompts]
+    outs = eng.run()
+    s = eng.metrics.summary()
+    assert s["requests_finished"] == 3
+    assert s["finish_reasons"] == {FINISH_LENGTH: 3}
+    # every emitted token is counted exactly once, prefill or decode
+    assert s["tokens_generated"] == sum(len(outs[r]) for r in rids) == 18
+    assert s["prefills"] == 3
+    assert s["tokens_per_sec"] > 0
+    assert 0 < s["slot_occupancy"] <= 1
+    assert len(eng.metrics.ttft_s) == 3 and len(eng.metrics.tpot_s) == 3
+    assert s["ttft_s"]["p99"] >= s["ttft_s"]["p50"] > 0
+
+
+# ------------------------------------------------------------------ #
+# pipeline bridge
+# ------------------------------------------------------------------ #
+
+
+class FakePipelineEngine:
+    """Quacks like runtime/pipe/engine.PipelineEngine for serving: exposes
+    serving_logits_fn() returning inference_batch-shaped logits."""
+
+    def __init__(self, apply_fn, params):
+        self._apply, self._params = apply_fn, params
+
+    def serving_logits_fn(self):
+        return lambda toks: np.asarray(self._apply(self._params,
+                                                   jnp.asarray(toks)))
+
+
+def test_bridge_from_pipeline_engine_greedy_parity(model):
+    cfg, params, apply_fn = model
+    rs = np.random.RandomState(9)
+    prompts = [rs.randint(0, 97, (n,)).tolist() for n in (4, 7, 5)]
+    news = [6, 4, 7]
+    refs = _ref_outputs(cfg, params, prompts, news)
+
+    bridge = PipelineServingBridge.from_pipeline_engine(
+        FakePipelineEngine(apply_fn, params),
+        ServingConfig(num_slots=2, block_size=8, num_blocks=16,
+                      max_seq_len=32))
+    rids = [bridge.submit(p, max_new_tokens=m)
+            for p, m in zip(prompts, news)]
+    outs = bridge.run()
+    for rid, ref in zip(rids, refs):
+        np.testing.assert_array_equal(outs[rid], ref)
+    assert bridge.metrics.summary()["requests_finished"] == 3
+
+
+# ------------------------------------------------------------------ #
+# TrainingConfig "serving" block
+# ------------------------------------------------------------------ #
+
+
+def test_training_config_serving_block_roundtrip():
+    cfg = TrainingConfig(
+        {"train_batch_size": 8,
+         "serving": {"num_slots": 2, "block_size": 8, "num_blocks": 16,
+                     "max_seq_len": 64}},
+        world_size=8)
+    assert cfg.serving_enabled
+    scfg = cfg.serving_config()
+    assert isinstance(scfg, ServingConfig)
+    assert (scfg.num_slots, scfg.num_blocks) == (2, 16)
+
+    off = TrainingConfig({"train_batch_size": 8}, world_size=8)
+    assert not off.serving_enabled and off.serving_config() is None
+    disabled = TrainingConfig(
+        {"train_batch_size": 8, "serving": {"enabled": False}}, world_size=8)
+    assert not disabled.serving_enabled
+    assert disabled.serving_config() is None
+
+
+def test_training_config_serving_block_rejects_typos():
+    with pytest.raises(ConfigError, match="num_slot"):
+        TrainingConfig({"train_batch_size": 8, "serving": {"num_slot": 2}},
+                       world_size=8)
+    with pytest.raises(ConfigError, match="must be a dict"):
+        TrainingConfig({"train_batch_size": 8, "serving": True},
+                       world_size=8)
+
+
+# ------------------------------------------------------------------ #
+# stress (excluded from tier-1 via -m 'not slow')
+# ------------------------------------------------------------------ #
+
+
+@pytest.mark.slow
+def test_stress_many_requests_small_pool(model):
+    """12 mixed-length requests through 3 slots and a deliberately tight
+    pool: backpressure + repeated preemption, full greedy parity."""
+    cfg, params, _ = model
+    rs = np.random.RandomState(10)
+    lens = rs.randint(3, 12, (12,))
+    news = rs.randint(4, 12, (12,))
+    prompts = [rs.randint(0, 97, (n,)).tolist() for n in lens]
+    refs = _ref_outputs(cfg, params, prompts, news)
+
+    scfg = ServingConfig(num_slots=3, block_size=4, num_blocks=10,
+                         max_seq_len=24)
+    eng = ServingEngine(cfg, params, scfg)
+    rids = [eng.submit(p, max_new_tokens=int(m))
+            for p, m in zip(prompts, news)]
+    outs = eng.run()
+    for rid, ref in zip(rids, refs):
+        np.testing.assert_array_equal(outs[rid], ref)
+    assert eng.decode_compile_count == 1
+    assert eng.metrics.summary()["requests_finished"] == 12

@@ -1,0 +1,170 @@
+"""Paged KV cache unit tests: block allocator invariants (alloc/free/
+reuse, out-of-blocks backpressure), serving config validation, prefill
+page scatter, and no cross-request cache leakage after slot reuse."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeperspeed_tpu.models.generation import apply_with_cache, init_cache
+from deeperspeed_tpu.models.gpt import GPTConfig, make_gpt
+from deeperspeed_tpu.serving import (
+    BlockAllocator,
+    PagedKVCache,
+    ServingConfig,
+    ServingEngine,
+    blocks_needed,
+)
+from deeperspeed_tpu.serving.kv_cache import NULL_BLOCK, OutOfBlocks
+
+
+def _cfg(**kw):
+    d = dict(vocab_size=97, n_layer=2, n_head=2, d_model=32, max_seq=64,
+             remat=False, dtype=jnp.float32, attn_impl="xla")
+    d.update(kw)
+    return GPTConfig(**d)
+
+
+# ------------------------------------------------------------------ #
+# allocator
+# ------------------------------------------------------------------ #
+
+
+def test_allocator_alloc_free_reuse():
+    a = BlockAllocator(8)             # 7 usable, block 0 reserved
+    assert a.num_free == 7
+    b1 = a.alloc(3)
+    b2 = a.alloc(2)
+    assert len(b1) == 3 and len(b2) == 2
+    assert NULL_BLOCK not in b1 + b2          # block 0 never handed out
+    assert len(set(b1 + b2)) == 5             # no double-allocation
+    assert a.num_free == 2 and a.num_allocated == 5
+    a.free(b1)
+    assert a.num_free == 5
+    b3 = a.alloc(5)                   # reuse of freed blocks
+    assert b3 is not None and len(set(b3)) == 5
+    assert set(b1) <= set(b3) | set(b2) or set(b1) & set(b3)
+
+
+def test_allocator_exhaustion_is_backpressure_not_crash():
+    a = BlockAllocator(4)             # 3 usable
+    held = a.alloc(3)
+    assert a.alloc(1) is None         # dry pool: None, not an exception
+    assert a.alloc(0) == []           # zero-block request always succeeds
+    # all-or-nothing: asking for more than free grants nothing
+    a.free(held[:1])
+    assert a.alloc(2) is None
+    assert a.num_free == 1            # the failed alloc leaked nothing
+    assert a.alloc(1) is not None
+
+
+def test_allocator_double_free_raises():
+    a = BlockAllocator(4)
+    b = a.alloc(2)
+    a.free(b)
+    with pytest.raises(OutOfBlocks, match="double free"):
+        a.free(b)
+    with pytest.raises(OutOfBlocks):
+        a.free([NULL_BLOCK])          # the null block is never allocated
+
+
+def test_blocks_needed():
+    assert blocks_needed(0, 8) == 0
+    assert blocks_needed(1, 8) == 1
+    assert blocks_needed(8, 8) == 1
+    assert blocks_needed(9, 8) == 2
+
+
+# ------------------------------------------------------------------ #
+# config
+# ------------------------------------------------------------------ #
+
+
+def test_serving_config_validation():
+    scfg = ServingConfig(block_size=8, max_seq_len=48, num_blocks=16)
+    assert scfg.blocks_per_slot == 6
+    assert scfg.usable_blocks == 15
+    # derived buckets: multiples of block_size doubling up to the cap
+    assert scfg.prefill_buckets[0] == 8
+    assert scfg.prefill_buckets[-1] >= 48
+    assert all(b % 8 == 0 for b in scfg.prefill_buckets)
+    assert scfg.bucket_for(1) == 8
+    assert scfg.bucket_for(9) == 16
+    with pytest.raises(ValueError, match="largest prefill bucket"):
+        ServingConfig(prefill_buckets=(16,), max_seq_len=64)
+    with pytest.raises(ValueError, match="num_blocks"):
+        ServingConfig(num_blocks=1)
+    with pytest.raises(ValueError, match="multiple of block_size"):
+        ServingConfig(block_size=8, prefill_buckets=(12, 512))
+
+
+def test_serving_config_from_dict_rejects_unknown_keys():
+    scfg = ServingConfig.from_dict(
+        {"num_slots": 4, "block_size": 8, "num_blocks": 32,
+         "enabled": True})
+    assert scfg.num_slots == 4
+    with pytest.raises(ValueError, match="num_slot"):
+        ServingConfig.from_dict({"num_slot": 4})     # typo'd key
+
+
+# ------------------------------------------------------------------ #
+# prefill page scatter
+# ------------------------------------------------------------------ #
+
+
+def test_write_prefill_scatters_pages_exactly():
+    cfg = _cfg()
+    scfg = ServingConfig(num_slots=2, block_size=4, num_blocks=16,
+                         max_seq_len=32)
+    kv = PagedKVCache(cfg, scfg)
+    init_fn, _, _, _ = make_gpt(cfg)
+    params = init_fn(jax.random.PRNGKey(0))
+    L = 10                                     # 3 blocks, last partial
+    toks = jnp.asarray(np.random.RandomState(0).randint(0, 97, (1, 12)))
+    _, cache = apply_with_cache(cfg, params, toks, init_cache(cfg, 1, 12), 0)
+    blocks = kv.allocator.alloc(blocks_needed(L, 4))
+    kv.write_prefill(cache["k"], cache["v"], blocks, L)
+    pool_k = np.asarray(kv.k)
+    dense_k = np.asarray(cache["k"])[:, 0]     # (L_layers, 12, Hkv, Dh)
+    for i, b in enumerate(blocks):
+        np.testing.assert_array_equal(pool_k[:, b],
+                                      dense_k[:, 4 * i: 4 * i + 4])
+    # unallocated blocks stay untouched (zeros)
+    untouched = sorted(set(range(16)) - set(blocks) - {NULL_BLOCK})
+    assert np.all(pool_k[:, untouched] == 0)
+
+
+# ------------------------------------------------------------------ #
+# slot reuse: no cross-request leakage
+# ------------------------------------------------------------------ #
+
+
+def test_no_cross_request_leakage_after_slot_reuse():
+    """Request B lands in the slot (and physical blocks) request A just
+    vacated; B's output must be identical to serving B alone on a fresh
+    engine — stale A rows beyond B's length are masked, overlapping rows
+    overwritten."""
+    cfg = _cfg()
+    init_fn, _, _, _ = make_gpt(cfg)
+    params = init_fn(jax.random.PRNGKey(0))
+    scfg = ServingConfig(num_slots=1, block_size=4, num_blocks=16,
+                         max_seq_len=40)
+    rs = np.random.RandomState(3)
+    a_prompt = rs.randint(0, 97, (17,)).tolist()   # long: dirties 5+ blocks
+    b_prompt = rs.randint(0, 97, (5,)).tolist()    # short: partial overlap
+
+    eng = ServingEngine(cfg, params, scfg)
+    ra = eng.submit(a_prompt, max_new_tokens=12)
+    rb = eng.submit(b_prompt, max_new_tokens=12)   # queued until A finishes
+    outs = eng.run()
+    assert eng.get(rb).slot == -1 and eng.get(rb).admissions == 1
+
+    fresh = ServingEngine(cfg, params, scfg)
+    rb2 = fresh.submit(b_prompt, max_new_tokens=12)
+    np.testing.assert_array_equal(outs[rb], fresh.run()[rb2])
+    # and A itself was untouched by B being queued
+    fresh2 = ServingEngine(cfg, params, scfg)
+    ra2 = fresh2.submit(a_prompt, max_new_tokens=12)
+    np.testing.assert_array_equal(outs[ra], fresh2.run()[ra2])
