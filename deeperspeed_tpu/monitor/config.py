@@ -1,0 +1,82 @@
+"""Monitor-block configuration.
+
+The telemetry counterpart of the ``"serving"`` block: a ``"monitor"``
+block in the master JSON config (or a plain dict) builds a
+``MonitorConfig``. Everything is off by default — tracing, the recompile
+watchdog, and the metrics endpoint only exist when the block asks for
+them, so the hot path pays nothing otherwise.
+
+::
+
+    "monitor": {
+        "trace_path": "/tmp/step.trace.json",  # null = keep in memory
+        "ring_size": 65536,                    # bounded event memory
+        "watchdog": "warn",                    # off | warn | strict
+        "metrics_port": 9184,                  # null = no endpoint; 0 = ephemeral
+        "metrics_host": "127.0.0.1",
+        "tb_export_interval": 0                # steps; 0 = no TB export
+    }
+"""
+
+import dataclasses
+from typing import Optional
+
+from .watchdog import MODES
+
+_KNOWN_KEYS = frozenset({
+    "enabled", "trace_enabled", "trace_path", "ring_size", "watchdog",
+    "metrics_port", "metrics_host", "tb_export_interval",
+})
+
+
+@dataclasses.dataclass(frozen=True)
+class MonitorConfig:
+    # master switch; runtime/config.py treats block presence as enabled
+    # unless {"enabled": false}
+    enabled: bool = True
+    # span/counter/instant tracing into the ring buffer
+    trace_enabled: bool = True
+    # where Monitor.save_trace()/shutdown() write the Chrome-trace JSON;
+    # None keeps events in memory for the caller to export
+    trace_path: Optional[str] = None
+    # ring-buffer capacity (events); memory stays bounded at ~200B/event
+    ring_size: int = 65536
+    # recompile watchdog mode: "off", "warn" (rank-0 warning + trace
+    # instant), "strict" (raise RecompileError)
+    watchdog: str = "warn"
+    # Prometheus endpoint port; None disables the server, 0 binds an
+    # ephemeral port (exposed as Monitor.metrics_server.port)
+    metrics_port: Optional[int] = None
+    metrics_host: str = "127.0.0.1"
+    # export the metrics registry through TensorBoardMonitor every N
+    # steps; 0 disables
+    tb_export_interval: int = 0
+
+    def __post_init__(self):
+        if self.ring_size < 1:
+            raise ValueError(f"ring_size must be >= 1, got {self.ring_size}")
+        if self.watchdog not in MODES:
+            raise ValueError(
+                f"watchdog must be one of {MODES}, got {self.watchdog!r}")
+        if self.metrics_port is not None and not (
+                0 <= int(self.metrics_port) <= 65535):
+            raise ValueError(
+                f"metrics_port must be 0..65535 or null, got "
+                f"{self.metrics_port}")
+        if self.tb_export_interval < 0:
+            raise ValueError(
+                f"tb_export_interval must be >= 0, got "
+                f"{self.tb_export_interval}")
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "MonitorConfig":
+        """Build from a ``"monitor"`` config block; unknown keys raise
+        (same typo discipline as ServingConfig.from_dict)."""
+        if d is None:
+            return cls()
+        unknown = set(d) - _KNOWN_KEYS
+        if unknown:
+            raise ValueError(
+                f"unknown monitor config keys {sorted(unknown)}; known "
+                f"keys are {sorted(_KNOWN_KEYS)}")
+        return cls(**d)

@@ -1,0 +1,138 @@
+"""Chrome-trace schema validator.
+
+Checks the invariants Perfetto/chrome://tracing rely on, so a bad trace
+fails in CI instead of rendering as an empty timeline:
+
+  * top level is a JSON event array or ``{"traceEvents": [...]}``;
+  * every event is an object carrying ``ph``, ``pid``, ``tid`` (and
+    ``name`` + numeric non-negative ``ts`` for non-metadata phases);
+  * ``ph`` is a known phase; ``"X"`` events carry a numeric
+    non-negative ``dur``;
+  * ``"B"``/``"E"`` pairs balance per ``(pid, tid)`` track with proper
+    LIFO nesting (an ``E`` must close the innermost open ``B`` of the
+    same name).
+
+Used two ways: as a library (``validate_events`` / ``validate_file``,
+the pytest round-trips a generated trace through it) and as a CLI::
+
+    python -m deeperspeed_tpu.monitor.validate trace.json
+
+exit 0 = valid, exit 1 = problems (one per line on stderr).
+"""
+
+import json
+import sys
+from typing import List
+
+__all__ = ["validate_events", "validate_file", "main"]
+
+# phases from the Trace Event Format spec; "M" (metadata) and "C"
+# (counter) are what the tracer emits beyond spans/instants
+KNOWN_PHASES = set("BEXiICMPSTFsftbenO(N)D{}v")
+
+_NUM = (int, float)
+
+
+def _is_num(v) -> bool:
+    return isinstance(v, _NUM) and not isinstance(v, bool)
+
+
+def validate_events(events) -> List[str]:
+    """Returns a list of problems; empty means the trace is valid."""
+    if not isinstance(events, list):
+        return [f"traceEvents must be a list, got {type(events).__name__}"]
+    errors: List[str] = []
+    open_stacks = {}  # (pid, tid) -> [names of open B events]
+    for i, ev in enumerate(events):
+        where = f"event[{i}]"
+        if not isinstance(ev, dict):
+            errors.append(f"{where}: not an object "
+                          f"({type(ev).__name__})")
+            continue
+        ph = ev.get("ph")
+        if ph is None:
+            errors.append(f"{where}: missing required field 'ph'")
+            continue
+        if not isinstance(ph, str) or ph not in KNOWN_PHASES:
+            errors.append(f"{where}: unknown phase {ph!r}")
+            continue
+        for field in ("pid", "tid"):
+            if field not in ev:
+                errors.append(f"{where} (ph={ph}): missing required "
+                              f"field {field!r}")
+        if ph == "M":
+            continue  # metadata: no ts/name requirements
+        if "name" not in ev:
+            errors.append(f"{where} (ph={ph}): missing required field "
+                          f"'name'")
+        ts = ev.get("ts")
+        if ts is None:
+            errors.append(f"{where} (ph={ph}): missing required field 'ts'")
+        elif not _is_num(ts) or ts < 0:
+            errors.append(f"{where} (ph={ph}): 'ts' must be a "
+                          f"non-negative number, got {ts!r}")
+        if ph == "X":
+            dur = ev.get("dur")
+            if dur is None:
+                errors.append(f"{where}: 'X' event missing 'dur'")
+            elif not _is_num(dur) or dur < 0:
+                errors.append(f"{where}: 'dur' must be a non-negative "
+                              f"number, got {dur!r}")
+        if ph in ("B", "E"):
+            track = (ev.get("pid"), ev.get("tid"))
+            stack = open_stacks.setdefault(track, [])
+            name = ev.get("name")
+            if ph == "B":
+                stack.append(name)
+            else:
+                if not stack:
+                    errors.append(f"{where}: 'E' with no open 'B' on "
+                                  f"track pid={track[0]} tid={track[1]}")
+                elif stack[-1] != name:
+                    errors.append(
+                        f"{where}: 'E' for {name!r} does not close the "
+                        f"innermost open 'B' ({stack[-1]!r}) on track "
+                        f"pid={track[0]} tid={track[1]}")
+                    stack.pop()
+                else:
+                    stack.pop()
+    for (pid, tid), stack in open_stacks.items():
+        for name in stack:
+            errors.append(f"unbalanced 'B' event {name!r} never closed "
+                          f"on track pid={pid} tid={tid}")
+    return errors
+
+
+def validate_file(path: str) -> List[str]:
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except OSError as e:
+        return [f"cannot read {path}: {e}"]
+    except json.JSONDecodeError as e:
+        return [f"{path} is not valid JSON: {e}"]
+    if isinstance(doc, dict):
+        if "traceEvents" not in doc:
+            return [f"{path}: object form must carry 'traceEvents'"]
+        doc = doc["traceEvents"]
+    return validate_events(doc)
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if len(argv) != 1 or argv[0] in ("-h", "--help"):
+        print(__doc__, file=sys.stderr)
+        return 2
+    errors = validate_file(argv[0])
+    if errors:
+        for e in errors:
+            print(e, file=sys.stderr)
+        print(f"{argv[0]}: INVALID ({len(errors)} problem(s))",
+              file=sys.stderr)
+        return 1
+    print(f"{argv[0]}: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,185 @@
+"""Recompile watchdog: catch silent XLA retraces after warmup.
+
+On TPU the dominant invisible failure mode is a jitted hot function
+quietly recompiling — a shape or dtype leaked into the trace, a python
+scalar that should have been a traced array, a config knob that varies
+per call. Wall-clock timers show a mysterious multi-second step; this
+watchdog names the function that did it.
+
+Two signals:
+
+  * Per-function jit cache sizes (``fn._cache_size()`` on jitted
+    callables — the same counter ``ServingEngine.decode_compile_count``
+    already exposes). ``watch(name, fn)`` registers a function;
+    ``observe(name)`` is called by the owning engine after each hot-path
+    invocation. The first observation that finds a non-empty cache marks
+    the function WARM and records the baseline; any growth past the
+    baseline afterwards fires the watchdog.
+  * ``jax.monitoring`` backend-compile duration events (when available)
+    feed a process-global compile counter and a trace instant per
+    compile, so even unwatched compiles show up on the timeline.
+
+Firing emits a trace instant (``recompile!``) plus a rank-0 warning; in
+``strict`` mode it raises :class:`RecompileError` instead — the mode the
+serving tests run under, proving the decode step compiles exactly once
+across a multi-request run.
+"""
+
+import threading
+from typing import Callable, Dict, List, Optional
+
+from ..utils.logging import logger
+from .tracer import trace_instant
+
+__all__ = ["RecompileError", "RecompileWatchdog", "install_compile_listener"]
+
+MODES = ("off", "warn", "strict")
+
+# process-global compile-event counter fed by jax.monitoring (see
+# install_compile_listener); None until the listener is installed
+_compile_events = 0
+_listener_installed = False
+_listener_lock = threading.Lock()
+_COMPILE_EVENT_KEY = "backend_compile"
+
+
+def _on_duration_event(event: str, duration: float, **kwargs) -> None:
+    global _compile_events
+    if _COMPILE_EVENT_KEY in event:
+        _compile_events += 1
+        trace_instant("xla_compile", lane="compile",
+                      seconds=round(duration, 4))
+
+
+def install_compile_listener() -> bool:
+    """Register the jax.monitoring duration listener (once per process;
+    jax offers no per-listener unregister so it stays installed). Returns
+    True when the listener is active."""
+    global _listener_installed
+    with _listener_lock:
+        if _listener_installed:
+            return True
+        try:
+            from jax import monitoring
+            monitoring.register_event_duration_secs_listener(
+                _on_duration_event)
+        except Exception:  # pragma: no cover - very old jax
+            return False
+        _listener_installed = True
+        return True
+
+
+def global_compile_events() -> int:
+    """Backend compiles observed process-wide since listener install."""
+    return _compile_events
+
+
+def _cache_size(fn) -> Optional[int]:
+    get = getattr(fn, "_cache_size", None)
+    if get is None:
+        return None
+    try:
+        return int(get())
+    except Exception:  # pragma: no cover - defensive
+        return None
+
+
+class RecompileError(RuntimeError):
+    """Raised in strict mode when a watched function recompiles after
+    warmup."""
+
+
+class RecompileWatchdog:
+    def __init__(self, mode: str = "warn"):
+        if mode not in MODES:
+            raise ValueError(f"watchdog mode must be one of {MODES}, "
+                             f"got {mode!r}")
+        self.mode = mode
+        self._lock = threading.Lock()
+        self._fns: Dict[str, Callable] = {}
+        self._baseline: Dict[str, Optional[int]] = {}  # None until warm
+        self.fired: List[dict] = []  # one record per detected recompile
+        if mode != "off":
+            install_compile_listener()
+
+    @property
+    def enabled(self) -> bool:
+        return self.mode != "off"
+
+    # -------------------------------------------------------------- #
+
+    def watch(self, name: str, fn: Callable) -> None:
+        """Register a jitted function under ``name`` (idempotent; re-
+        registering a new fn object resets its warmup)."""
+        with self._lock:
+            if self._fns.get(name) is fn:
+                return
+            self._fns[name] = fn
+            self._baseline[name] = None
+
+    def watched(self) -> List[str]:
+        with self._lock:
+            return list(self._fns)
+
+    def counts(self) -> Dict[str, Optional[int]]:
+        """Current jit-cache entry count per watched function."""
+        with self._lock:
+            fns = dict(self._fns)
+        return {name: _cache_size(fn) for name, fn in fns.items()}
+
+    def mark_warm(self, name: Optional[str] = None) -> None:
+        """Snapshot current cache sizes as the post-warmup baseline
+        (``observe`` does this automatically on the first non-empty
+        sighting; call this to warm explicitly, e.g. after a warmup
+        batch)."""
+        with self._lock:
+            names = [name] if name is not None else list(self._fns)
+            for n in names:
+                self._baseline[n] = _cache_size(self._fns[n])
+
+    def observe(self, name: Optional[str] = None) -> List[str]:
+        """Compare watched functions' cache sizes against their warm
+        baselines; returns the names that recompiled (after firing the
+        configured reaction for each)."""
+        if not self.enabled:
+            return []
+        with self._lock:
+            items = ([(name, self._fns[name])] if name is not None
+                     else list(self._fns.items()))
+        recompiled = []
+        for n, fn in items:
+            size = _cache_size(fn)
+            if size is None:
+                continue
+            base = self._baseline.get(n)
+            if base is None:
+                if size > 0:  # first compile = warmup, not a violation
+                    with self._lock:
+                        self._baseline[n] = size
+                continue
+            if size > base:
+                with self._lock:
+                    self._baseline[n] = size  # report each growth once
+                recompiled.append(n)
+                self._fire(n, base, size)
+        return recompiled
+
+    # -------------------------------------------------------------- #
+
+    def _fire(self, name: str, baseline: int, size: int) -> None:
+        record = {"name": name, "baseline": baseline, "cache_size": size}
+        self.fired.append(record)
+        trace_instant("recompile!", lane="compile", fn=name,
+                      cache_size=size)
+        msg = (f"recompile watchdog: {name!r} recompiled after warmup "
+               f"(jit cache {baseline} -> {size}); a shape/dtype is "
+               f"leaking into the trace")
+        if self.mode == "strict":
+            raise RecompileError(msg)
+        try:
+            import jax
+            rank0 = jax.process_index() == 0
+        except Exception:  # pragma: no cover
+            rank0 = True
+        if rank0:
+            logger.warning(msg)

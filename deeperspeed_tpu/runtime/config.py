@@ -301,6 +301,32 @@ class TrainingConfig:
             except ValueError as e:
                 raise ConfigError(f'invalid "serving" block: {e}') from e
 
+        # ---- unified telemetry ----
+        # A "monitor" block turns on step tracing / the recompile
+        # watchdog / the metrics endpoint (monitor/ package). Validated
+        # eagerly like "serving" so typos fail at load time.
+        self.monitor_params = pd.get(c.MONITOR, None)
+        if self.monitor_params is not None and not isinstance(
+                self.monitor_params, dict):
+            raise ConfigError(
+                '"monitor" must be a dict of MonitorConfig overrides '
+                '(or {"enabled": false})'
+            )
+        explicit_monitor = (self.monitor_params or {}).get(c.MONITOR_ENABLED)
+        self.monitor_enabled = (
+            explicit_monitor if explicit_monitor is not None
+            else self.monitor_params is not None
+        )
+        self._monitor_config = None
+        if self.monitor_enabled:
+            from ..monitor.config import MonitorConfig
+
+            try:
+                self._monitor_config = MonitorConfig.from_dict(
+                    dict(self.monitor_params, enabled=True))
+            except ValueError as e:
+                raise ConfigError(f'invalid "monitor" block: {e}') from e
+
         bs_sched = pd.get(c.BATCH_SCHEDULER, {})
         if isinstance(bs_sched, dict):
             self.batch_scheduler_enabled = bs_sched.get(
@@ -318,6 +344,11 @@ class TrainingConfig:
         absent or disabled). Built — and validated — at parse time so
         config typos fail at load, like every other block."""
         return self._serving_config
+
+    def monitor_config(self):
+        """The "monitor" block as a MonitorConfig (None when absent or
+        disabled); validated at parse time like "serving"."""
+        return self._monitor_config
 
     def get_sparse_attention(self, num_heads: int):
         """Build the configured SparsityConfig (reference runtime/config.py:213

@@ -222,3 +222,13 @@ STREAMING_ENABLED = "enabled"
 SERVING = "serving"
 SERVING_ENABLED = "enabled"
 SERVING_ENABLED_DEFAULT = False
+
+#############################################
+# Unified telemetry (monitor/ package): Chrome-trace step tracing,
+# recompile watchdog, Prometheus metrics endpoint. Keys are validated by
+# monitor.config.MonitorConfig.from_dict; block presence enables unless
+# {"enabled": false}.
+#############################################
+MONITOR = "monitor"
+MONITOR_ENABLED = "enabled"
+MONITOR_ENABLED_DEFAULT = False

@@ -46,6 +46,7 @@ from ..checkpoint.serialization import (
 from ..ops.adam import DeepSpeedCPUAdam, FusedAdam
 from ..ops.lamb import FusedLamb
 from ..ops.sgd import SGD
+from ..monitor import get_monitor, init_monitor, trace_span
 from ..parallel.topology import DATA_AXIS, build_mesh, single_device_mesh
 from ..utils.logging import log_dist, logger
 from ..utils.timer import SynchronizedWallClockTimer, ThroughputTimer
@@ -184,6 +185,21 @@ class Engine(ConfigAccessorsMixin):
         # tensorboard monitor (reference engine.py:163; writer on the first
         # process only, as the reference gates on global rank 0)
         self.summary_writer = make_summary_writer(config)
+
+        # unified telemetry (monitor/ package): a "monitor" config block
+        # installs the process-global tracer/watchdog/metrics endpoint;
+        # absent one, an already-installed monitor (init_monitor) is
+        # adopted so manual setups and config-driven ones compose
+        if config.monitor_config() is not None:
+            self.monitor = init_monitor(config.monitor_config())
+        else:
+            self.monitor = get_monitor()
+        # the fused train step legitimately traces twice: the initial
+        # state is an uncommitted single-device array, the step's output
+        # commits to a NamedSharding over the mesh, and the second call
+        # specializes to it. The first watchdog observation is therefore
+        # skipped so the warm baseline locks on the steady-state cache.
+        self._wd_warmup_left = 1
 
         # fork extras (reference engine.py:139,227): gradient stashing and
         # layer-output capture
@@ -867,7 +883,9 @@ class Engine(ConfigAccessorsMixin):
         wall = self._config.wall_clock_breakdown
         if wall:
             self._timer_start(FORWARD_MICRO_TIMER)
-        loss, grads = self._forward_grad_fn()(self.state, batch, rng)
+        with trace_span("engine/forward", lane="engine",
+                        micro_step=self.micro_steps):
+            loss, grads = self._forward_grad_fn()(self.state, batch, rng)
         if wall:
             # forward+backward are fused in this fn; the split is the
             # imperative API's, the timing is the fused step's
@@ -882,16 +900,19 @@ class Engine(ConfigAccessorsMixin):
         stashed_loss, grads = self._stashed
         self._last_micro_loss = stashed_loss  # for step()-path monitoring
         self._stashed = None
-        if self._grad_acc is None:
-            # bank the carry in the configured accumulation dtype (see
-            # grad_accum_dtype) so the imperative path matches train_batch
-            self._grad_acc = jax.tree.map(
-                lambda g: g.astype(self._grad_accum_dtype), grads
-            )
-        else:
-            self._grad_acc = jax.tree.map(
-                lambda a, g: a + g.astype(a.dtype), self._grad_acc, grads
-            )
+        with trace_span("engine/backward", lane="engine",
+                        micro_step=self.micro_steps):
+            if self._grad_acc is None:
+                # bank the carry in the configured accumulation dtype (see
+                # grad_accum_dtype) so the imperative path matches
+                # train_batch
+                self._grad_acc = jax.tree.map(
+                    lambda g: g.astype(self._grad_accum_dtype), grads
+                )
+            else:
+                self._grad_acc = jax.tree.map(
+                    lambda a, g: a + g.astype(a.dtype), self._grad_acc, grads
+                )
         self._acc_count += 1
         return loss
 
@@ -910,18 +931,21 @@ class Engine(ConfigAccessorsMixin):
             banked = jax.tree.map(
                 lambda g: g.astype(self._grad_dtype), self._grad_acc
             )
-            if self._offload is not None:
-                grads, gnorm, finite = self._offload_post_fn()(
-                    self.state, banked, np.float32(self._acc_count)
-                )
-                metrics = self._offload_apply(grads, gnorm, finite, None)
-            else:
-                lr = np.float32(self._current_lr())
-                # the imperative path banked unscaled-by-gas grads; scale in fn
-                new_state, metrics = self._apply_update_fn()(
-                    self.state, banked, lr, np.float32(self._acc_count)
-                )
-                self.state = new_state
+            with trace_span("engine/step", lane="engine",
+                            step=self.global_steps):
+                if self._offload is not None:
+                    grads, gnorm, finite = self._offload_post_fn()(
+                        self.state, banked, np.float32(self._acc_count)
+                    )
+                    metrics = self._offload_apply(grads, gnorm, finite, None)
+                else:
+                    lr = np.float32(self._current_lr())
+                    # the imperative path banked unscaled-by-gas grads;
+                    # scale in fn
+                    new_state, metrics = self._apply_update_fn()(
+                        self.state, banked, lr, np.float32(self._acc_count)
+                    )
+                    self.state = new_state
             if self.store_gradients:
                 self._store_grads(banked)
             self._grad_acc = None
@@ -963,6 +987,16 @@ class Engine(ConfigAccessorsMixin):
                 tb_metrics.setdefault("_micro_loss", micro_loss)
             self._tb_pending = (tb_metrics, self._current_lr(),
                                 self.global_samples)
+        if self.monitor is not None:
+            self.monitor.registry.counter(
+                "train_steps_total", "optimizer steps taken").inc()
+            self.monitor.registry.gauge(
+                "train_global_samples", "samples consumed").set(
+                    self.global_samples)
+            ivl = self.monitor.config.tb_export_interval
+            if ivl and self.global_steps % ivl == 0:
+                self.monitor.export_tensorboard(self.summary_writer,
+                                                self.global_samples)
         self._pending_metrics = metrics
         if self._loss_scaler.dynamic:
             overflow = bool(jax.device_get(metrics["overflow"]))
@@ -997,25 +1031,39 @@ class Engine(ConfigAccessorsMixin):
         self.tput_timer.start()
         if self._layer_collector is not None:
             self._layer_collector.clear()
-        if self._offload is not None:
-            loss, grads, gnorm, finite = self._offload_grads_fn()(
-                self.state, batch, rng
-            )
-            metrics = self._offload_apply(grads, gnorm, finite, loss)
-        elif self.store_gradients:
-            # unfused route so the grads are observable (reference
-            # engine.py:1156 clones p.grad at step time)
-            loss, grads = self._batch_grads_fn()(self.state, batch, rng)
-            self._store_grads(grads)
-            new_state, metrics = self._apply_update_fn()(
-                self.state, grads, lr,
-                np.float32(self.gradient_accumulation_steps()),
-            )
-            metrics = dict(metrics, loss=loss)
-            self.state = new_state
-        else:
-            new_state, metrics = self._train_batch_fn()(self.state, batch, lr, rng)
-            self.state = new_state
+        wd = self.monitor.watchdog if self.monitor is not None else None
+        with trace_span("engine/train_batch", lane="engine",
+                        step=self.global_steps):
+            if self._offload is not None:
+                loss, grads, gnorm, finite = self._offload_grads_fn()(
+                    self.state, batch, rng
+                )
+                metrics = self._offload_apply(grads, gnorm, finite, loss)
+            elif self.store_gradients:
+                # unfused route so the grads are observable (reference
+                # engine.py:1156 clones p.grad at step time)
+                loss, grads = self._batch_grads_fn()(self.state, batch, rng)
+                self._store_grads(grads)
+                new_state, metrics = self._apply_update_fn()(
+                    self.state, grads, lr,
+                    np.float32(self.gradient_accumulation_steps()),
+                )
+                metrics = dict(metrics, loss=loss)
+                self.state = new_state
+            else:
+                fn = self._train_batch_fn()
+                if wd is not None:
+                    wd.watch("engine/train_step", fn)
+                new_state, metrics = fn(self.state, batch, lr, rng)
+                self.state = new_state
+        if wd is not None:
+            # the train step must compile once (after sharding commits,
+            # see __init__) and stay compiled; cache growth past the warm
+            # baseline means a shape/dtype leaked into the trace
+            if self._wd_warmup_left:
+                self._wd_warmup_left -= 1
+            else:
+                wd.observe()
         self.micro_steps += self.gradient_accumulation_steps()
         self._after_optimizer_step(metrics)
         self.tput_timer.stop(global_step=True, sync_with=metrics["loss"])

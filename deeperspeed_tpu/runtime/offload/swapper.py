@@ -26,6 +26,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ...monitor import trace_span
 from ...ops.aio import AsyncIOHandle, aligned_empty
 from ...utils.logging import logger
 from .aio_config import AioConfig
@@ -142,27 +143,32 @@ class AsyncPartitionedParameterSwapper:
 
     def swap_out(self, pid, arr: np.ndarray, async_op: bool = False):
         arr = np.ascontiguousarray(arr)
-        self._shapes[pid] = (arr.shape, arr.dtype)
-        staged = aligned_empty(arr.shape, arr.dtype)
-        np.copyto(staged, arr)
-        if async_op:
-            self.aio.async_pwrite(staged, self._path(pid))
-            self._pending_writes.append(pid)
-            self._write_keepalive.append(staged)
-        else:
-            self.aio.sync_pwrite(staged, self._path(pid))
+        with trace_span("offload/param_swap_out", lane="offload",
+                        bytes=int(arr.nbytes), async_op=async_op):
+            self._shapes[pid] = (arr.shape, arr.dtype)
+            staged = aligned_empty(arr.shape, arr.dtype)
+            np.copyto(staged, arr)
+            if async_op:
+                self.aio.async_pwrite(staged, self._path(pid))
+                self._pending_writes.append(pid)
+                self._write_keepalive.append(staged)
+            else:
+                self.aio.sync_pwrite(staged, self._path(pid))
         self._available.pop(pid, None)
 
     def swap_in(self, pids: Sequence[object], async_op: bool = True):
-        for pid in pids:
-            shape, dtype = self._shapes[pid]
-            buf = aligned_empty(shape, dtype)
-            if async_op:
-                self.aio.async_pread(buf, self._path(pid))
-                self._pending_reads.append(pid)
-            else:
-                self.aio.sync_pread(buf, self._path(pid))
-            self._available[pid] = buf
+        pids = list(pids)
+        with trace_span("offload/param_swap_in", lane="offload",
+                        count=len(pids), async_op=async_op):
+            for pid in pids:
+                shape, dtype = self._shapes[pid]
+                buf = aligned_empty(shape, dtype)
+                if async_op:
+                    self.aio.async_pread(buf, self._path(pid))
+                    self._pending_reads.append(pid)
+                else:
+                    self.aio.sync_pread(buf, self._path(pid))
+                self._available[pid] = buf
 
     def synchronize_reads(self):
         if self._pending_reads or self._pending_writes:
@@ -238,20 +244,28 @@ class OptimizerStateSwapper:
         return list(self._layout)
 
     def swap_out(self, leaf: str, states: Dict[str, np.ndarray], async_op=False):
-        buf = self._pack(leaf, states)
-        if async_op:
-            self.aio_w.async_pwrite(buf, self._path(leaf), self._leaf_bytes[leaf])
-            return buf  # caller must keep alive until wait()
-        self.aio_w.sync_pwrite(buf, self._path(leaf), self._leaf_bytes[leaf])
-        return None
+        with trace_span("offload/optstate_swap_out", lane="offload",
+                        bytes=self._leaf_bytes[leaf], async_op=async_op):
+            buf = self._pack(leaf, states)
+            if async_op:
+                self.aio_w.async_pwrite(buf, self._path(leaf),
+                                        self._leaf_bytes[leaf])
+                return buf  # caller must keep alive until wait()
+            self.aio_w.sync_pwrite(buf, self._path(leaf),
+                                   self._leaf_bytes[leaf])
+            return None
 
     def swap_in(self, leaf: str, async_op=False):
-        buf = aligned_empty((self._leaf_bytes[leaf],), np.uint8)
-        if async_op:
-            self.aio.async_pread(buf, self._path(leaf), self._leaf_bytes[leaf])
-            return buf  # unpack after wait()
-        self.aio.sync_pread(buf, self._path(leaf), self._leaf_bytes[leaf])
-        return buf
+        with trace_span("offload/optstate_swap_in", lane="offload",
+                        bytes=self._leaf_bytes[leaf], async_op=async_op):
+            buf = aligned_empty((self._leaf_bytes[leaf],), np.uint8)
+            if async_op:
+                self.aio.async_pread(buf, self._path(leaf),
+                                     self._leaf_bytes[leaf])
+                return buf  # unpack after wait()
+            self.aio.sync_pread(buf, self._path(leaf),
+                                self._leaf_bytes[leaf])
+            return buf
 
     def unpack(self, leaf: str, buf: np.ndarray) -> Dict[str, np.ndarray]:
         return self._unpack(leaf, buf)
@@ -293,7 +307,8 @@ class PipelinedOptimizerSwapper(OptimizerStateSwapper):
                 self.swap_in(leaves[i + 1], async_op=True)
                 if i + 1 < len(leaves) else None
             )
-            step_fn(leaf, states)  # overlaps read(i+1) and write(i-1)
+            with trace_span("offload/host_step", lane="offload", leaf=leaf):
+                step_fn(leaf, states)  # overlaps read(i+1), write(i-1)
             write_keepalive.append(self.swap_out(leaf, states, async_op=True))
             if len(write_keepalive) > 2:
                 # bound host memory: drain write-behind before dropping buffers

@@ -35,6 +35,7 @@ from ...checkpoint.serialization import (
     to_host,
     write_latest,
 )
+from ...monitor import trace_span
 from ...parallel.topology import DATA_AXIS, MODEL_AXIS, PIPE_AXIS
 from ...utils.logging import log_dist, logger
 from ...utils.timer import SynchronizedWallClockTimer, ThroughputTimer
@@ -605,14 +606,20 @@ class PipelineEngine(ConfigAccessorsMixin):
         # pollute the training breakdown.
         wall = self._config.wall_clock_breakdown and train
 
-        def timed(name, fn, *a):
-            if not wall:
-                return fn(*a)
-            tm = self.timers(f"pipe_{name}")
-            tm.safe_start()
-            out = fn(*a)
-            tm.stop()
-            return out
+        def timed(name, fn, *a, stage=None):
+            # span per schedule instruction (named after the executor, one
+            # Perfetto lane per stage); timers keep the 4-phase buckets
+            span = "pipe/" + fn.__name__.replace("_exec_", "")
+            lane = "pipe" if stage is None else f"pipe/stage{stage}"
+            with trace_span(span, lane=lane,
+                            **({} if stage is None else {"stage": stage})):
+                if not wall:
+                    return fn(*a)
+                tm = self.timers(f"pipe_{name}")
+                tm.safe_start()
+                out = fn(*a)
+                tm.stop()
+                return out
 
         for t in range(total_steps):
             step_cmds = [
@@ -623,9 +630,11 @@ class PipelineEngine(ConfigAccessorsMixin):
             for s in range(self.num_stages):
                 for cmd in step_cmds[s]:
                     if isinstance(cmd, sched_mod.SendActivation):
-                        timed("comms", self._exec_send_activation, s, cmd.buffer_id)
+                        timed("comms", self._exec_send_activation, s,
+                              cmd.buffer_id, stage=s)
                     elif isinstance(cmd, sched_mod.SendGrad):
-                        timed("comms", self._exec_send_grad, s, cmd.buffer_id)
+                        timed("comms", self._exec_send_grad, s,
+                              cmd.buffer_id, stage=s)
             # Phase 2: everything else, stage order.
             did_global = False
             for s in range(self.num_stages):
@@ -633,15 +642,25 @@ class PipelineEngine(ConfigAccessorsMixin):
                     if isinstance(cmd, self._SEND_TYPES):
                         continue
                     if isinstance(cmd, sched_mod.RecvActivation):
-                        timed("comms", self._exec_recv_activation, s, cmd.buffer_id)
+                        timed("comms", self._exec_recv_activation, s,
+                              cmd.buffer_id, stage=s)
                     elif isinstance(cmd, sched_mod.RecvGrad):
-                        timed("comms", self._exec_recv_grad, s, cmd.buffer_id)
+                        timed("comms", self._exec_recv_grad, s,
+                              cmd.buffer_id, stage=s)
                     elif isinstance(cmd, sched_mod.LoadMicroBatch):
-                        self._exec_load_micro_batch(s, cmd.buffer_id, train)
+                        # traced but NOT timed: data loading stays in the
+                        # breakdown's 'other' bucket (see
+                        # _log_phase_breakdown)
+                        with trace_span("pipe/load_micro_batch",
+                                        lane=f"pipe/stage{s}", stage=s):
+                            self._exec_load_micro_batch(s, cmd.buffer_id,
+                                                        train)
                     elif isinstance(cmd, sched_mod.ForwardPass):
-                        timed("fwd", self._exec_forward_pass, s, cmd.buffer_id, train)
+                        timed("fwd", self._exec_forward_pass, s,
+                              cmd.buffer_id, train, stage=s)
                     elif isinstance(cmd, sched_mod.BackwardPass):
-                        timed("bwd", self._exec_backward_pass, s, cmd.buffer_id)
+                        timed("bwd", self._exec_backward_pass, s,
+                              cmd.buffer_id, stage=s)
                     elif isinstance(cmd, sched_mod.ReduceTiedGrads):
                         if not did_global:
                             timed("comms", self._exec_reduce_tied_grads)
@@ -688,10 +707,12 @@ class PipelineEngine(ConfigAccessorsMixin):
         if self._config.wall_clock_breakdown:
             self.timers("pipe_batch").safe_start()
         self.tput_timer.start()
-        self._pull_micro_batches(data_iter)
-        self._exec_schedule(sched_mod.TrainSchedule, train=True)
-        self.micro_steps += self.micro_batches
-        loss = self._aggregate_total_loss()
+        with trace_span("pipe/train_batch", lane="pipe",
+                        step=self.global_steps):
+            self._pull_micro_batches(data_iter)
+            self._exec_schedule(sched_mod.TrainSchedule, train=True)
+            self.micro_steps += self.micro_batches
+            loss = self._aggregate_total_loss()
         self.tput_timer.stop(global_step=True, sync_with=None)
         if (self.summary_writer is not None
                 and not getattr(self, "_last_step_skipped", False)):
@@ -759,9 +780,11 @@ class PipelineEngine(ConfigAccessorsMixin):
         saved = self.micro_batches
         self.micro_batches = 1
         try:
-            self._exec_schedule(
-                sched_mod.InferenceSchedule, train=False, compute_loss=False
-            )
+            with trace_span("pipe/inference_batch", lane="pipe"):
+                self._exec_schedule(
+                    sched_mod.InferenceSchedule, train=False,
+                    compute_loss=False
+                )
         finally:
             self.micro_batches = saved
         return self._outputs_final[-1]

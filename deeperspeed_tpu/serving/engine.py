@@ -47,6 +47,8 @@ import numpy as np
 from ..models.generation import apply_with_cache, init_cache, \
     prep_sampling_logits
 from ..models.gpt import GPTConfig, decoder_block, layer_norm
+from ..monitor import get_monitor, init_monitor
+from ..monitor.tracer import trace_counter, trace_span
 from ..utils.logging import logger
 from .config import ServingConfig
 from .kv_cache import PagedKVCache, blocks_needed, paged_attend
@@ -155,11 +157,20 @@ class _ServingBase:
     bridge; subclasses implement _admit_one (prefill) and _decode_all."""
 
     def __init__(self, scfg: ServingConfig, scheduler: Scheduler,
-                 clock, monitor):
+                 clock, monitor, monitor_config=None):
         self.scfg = scfg
         self.sched = scheduler
         self.clock = clock
-        self.metrics = ServingMetrics(scfg.num_slots, clock, monitor)
+        # telemetry facade (monitor/ package): own it when a config is
+        # passed, else adopt a process-global one if installed
+        if monitor_config is not None:
+            self.telemetry = init_monitor(monitor_config)
+        else:
+            self.telemetry = get_monitor()
+        registry = (self.telemetry.registry
+                    if self.telemetry is not None else None)
+        self.metrics = ServingMetrics(scfg.num_slots, clock, monitor,
+                                      registry)
         self._rid_counter = itertools.count()
         self._requests: Dict[str, Request] = {}
         self._step_i = 0
@@ -202,15 +213,20 @@ class _ServingBase:
     def step(self) -> List[Request]:
         """One scheduler iteration; returns requests finished by it."""
         n_done = len(self.sched.finished)
-        now = self.clock()
-        for req in self.sched.expire_timeouts(now):
-            self.metrics.record_finish(req, now)
-        while (adm := self.sched.pop_admissible()) is not None:
-            self._admit_one(*adm)
-        for _ in self.sched.ensure_decode_capacity():
-            self.metrics.record_preemption()
-        if self.sched.num_active:
-            self._decode_all()
+        with trace_span("serving/step", lane="serving", step=self._step_i):
+            now = self.clock()
+            for req in self.sched.expire_timeouts(now):
+                self.metrics.record_finish(req, now)
+            while (adm := self.sched.pop_admissible()) is not None:
+                self._admit_one(*adm)
+            for _ in self.sched.ensure_decode_capacity():
+                self.metrics.record_preemption()
+            trace_counter("serving/load", {
+                "queued": len(self.sched.queue),
+                "active": self.sched.num_active,
+            }, lane="serving")
+            if self.sched.num_active:
+                self._decode_all()
         self._step_i += 1
         self.metrics.export(self._step_i)
         return self.sched.finished[n_done:]
@@ -246,7 +262,7 @@ class ServingEngine(_ServingBase):
 
     def __init__(self, cfg: GPTConfig, params,
                  serving_config: Union[ServingConfig, dict, None] = None,
-                 clock=time.monotonic, monitor=None):
+                 clock=time.monotonic, monitor=None, monitor_config=None):
         scfg = (serving_config if isinstance(serving_config, ServingConfig)
                 else ServingConfig.from_dict(serving_config))
         if not cfg.rotary and scfg.max_seq_len > cfg.max_seq:
@@ -258,7 +274,7 @@ class ServingEngine(_ServingBase):
         self.params = params
         self.kv = PagedKVCache(cfg, scfg)
         super().__init__(scfg, Scheduler(scfg, self.kv.allocator, clock),
-                         clock, monitor)
+                         clock, monitor, monitor_config)
         self._decode_step = make_decode_step(cfg, scfg)
         # retraces once per prefill bucket (toks.shape[1] varies)
         self._prefill_step = jax.jit(
@@ -266,6 +282,11 @@ class ServingEngine(_ServingBase):
                 cfg, params, toks,
                 init_cache(cfg, toks.shape[0], toks.shape[1]), 0))
         self._key = jax.random.PRNGKey(scfg.seed)
+        if self.telemetry is not None:
+            # decode must stay one-compile forever; prefill legitimately
+            # retraces per length bucket, so it is deliberately unwatched
+            self.telemetry.watchdog.watch("serving/decode_step",
+                                          self._decode_step)
 
     # compile counters (tests assert decode compiles exactly once)
     @property
@@ -296,21 +317,24 @@ class ServingEngine(_ServingBase):
     def _admit_one(self, slot: int, req: Request, blocks: List[int]) -> None:
         """Length-bucketed prefill of the request's context into its
         allocated blocks; emits the request's next token."""
-        timer = self.metrics.timers(PREFILL_TIMER)
-        timer.safe_start()
         ctx = req.context
         L = len(ctx)
         bucket = self.scfg.bucket_for(L)
-        toks = np.zeros((1, bucket), np.int32)
-        toks[0, :L] = ctx
-        logits, cache = self._prefill_step(self.params, jnp.asarray(toks))
-        # admission allocated headroom for the first decode write; only
-        # the context's own pages carry prefill data
-        data_blocks = blocks[:blocks_needed(L, self.scfg.block_size)]
-        self.kv.write_prefill(cache["k"], cache["v"], data_blocks, L)
-        tok = self._pick_token(logits[0, L - 1], req)
-        req.generated.append(tok)
-        timer.stop(sync_with=self.kv.k)
+        with trace_span("serving/prefill", lane="serving", rid=req.rid,
+                        slot=slot, ctx_len=L, bucket=bucket):
+            timer = self.metrics.timers(PREFILL_TIMER)
+            timer.safe_start()
+            toks = np.zeros((1, bucket), np.int32)
+            toks[0, :L] = ctx
+            logits, cache = self._prefill_step(self.params,
+                                               jnp.asarray(toks))
+            # admission allocated headroom for the first decode write;
+            # only the context's own pages carry prefill data
+            data_blocks = blocks[:blocks_needed(L, self.scfg.block_size)]
+            self.kv.write_prefill(cache["k"], cache["v"], data_blocks, L)
+            tok = self._pick_token(logits[0, L - 1], req)
+            req.generated.append(tok)
+            timer.stop(sync_with=self.kv.k)
         logger.debug("serving: admitted %s to slot %d (ctx=%d bucket=%d)",
                      req.rid, slot, L, bucket)
         self._record_emitted(req, prefill=True)
@@ -331,14 +355,18 @@ class ServingEngine(_ServingBase):
             lengths[s] = req.cached_len
             tokens[s] = req.pending_token
             temps[s] = req.temperature
-        timer = self.metrics.timers(DECODE_TIMER)
-        timer.safe_start()
-        nxt, self.kv.k, self.kv.v = self._decode_step(
-            self.params, self.kv.k, self.kv.v, jnp.asarray(tables),
-            jnp.asarray(lengths), jnp.asarray(tokens), jnp.asarray(temps),
-            self._next_key())
-        nxt = np.asarray(nxt)                       # device sync
-        timer.stop()
+        with trace_span("serving/decode", lane="serving",
+                        n_active=len(active)):
+            timer = self.metrics.timers(DECODE_TIMER)
+            timer.safe_start()
+            nxt, self.kv.k, self.kv.v = self._decode_step(
+                self.params, self.kv.k, self.kv.v, jnp.asarray(tables),
+                jnp.asarray(lengths), jnp.asarray(tokens),
+                jnp.asarray(temps), self._next_key())
+            nxt = np.asarray(nxt)                   # device sync
+            timer.stop()
+        if self.telemetry is not None:
+            self.telemetry.watchdog.observe("serving/decode_step")
         self.metrics.record_decode_step(len(active), len(self.sched.queue),
                                         self.clock())
         for s, req in active:
@@ -367,7 +395,7 @@ class PipelineServingBridge(_ServingBase):
 
     def __init__(self, logits_fn,
                  serving_config: Union[ServingConfig, dict, None] = None,
-                 clock=time.monotonic, monitor=None):
+                 clock=time.monotonic, monitor=None, monitor_config=None):
         scfg = (serving_config if isinstance(serving_config, ServingConfig)
                 else ServingConfig.from_dict(serving_config))
         self.logits_fn = logits_fn
@@ -377,7 +405,7 @@ class PipelineServingBridge(_ServingBase):
 
         alloc = BlockAllocator(1 + scfg.num_slots * scfg.blocks_per_slot)
         super().__init__(scfg, Scheduler(scfg, alloc, clock), clock,
-                         monitor)
+                         monitor, monitor_config)
         self._key = jax.random.PRNGKey(scfg.seed)
 
     @classmethod
@@ -403,18 +431,22 @@ class PipelineServingBridge(_ServingBase):
         self._record_emitted(req, prefill=prefill)
 
     def _admit_one(self, slot: int, req: Request, blocks) -> None:
-        timer = self.metrics.timers(PREFILL_TIMER)
-        timer.safe_start()
-        self._emit_next(req, prefill=True)
-        timer.stop()
+        with trace_span("serving/prefill", lane="serving", rid=req.rid,
+                        slot=slot, ctx_len=len(req.context)):
+            timer = self.metrics.timers(PREFILL_TIMER)
+            timer.safe_start()
+            self._emit_next(req, prefill=True)
+            timer.stop()
 
     def _decode_all(self) -> None:
-        timer = self.metrics.timers(DECODE_TIMER)
-        timer.safe_start()
         active = list(self.sched.active)
-        for req in active:
-            self._emit_next(req, prefill=False)
-        timer.stop()
+        with trace_span("serving/decode", lane="serving",
+                        n_active=len(active)):
+            timer = self.metrics.timers(DECODE_TIMER)
+            timer.safe_start()
+            for req in active:
+                self._emit_next(req, prefill=False)
+            timer.stop()
         self.metrics.record_decode_step(len(active),
                                         len(self.sched.queue),
                                         self.clock())

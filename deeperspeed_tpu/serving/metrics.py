@@ -13,6 +13,7 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
+from ..monitor.metrics import DEFAULT_LATENCY_BUCKETS, MetricsRegistry
 from ..utils.tensorboard import TensorBoardMonitor
 from ..utils.timer import SynchronizedWallClockTimer
 
@@ -36,10 +37,12 @@ def _percentiles(xs: List[float]) -> Dict[str, float]:
 class ServingMetrics:
     def __init__(self, num_slots: int,
                  clock: Callable[[], float] = time.monotonic,
-                 monitor: Optional[TensorBoardMonitor] = None):
+                 monitor: Optional[TensorBoardMonitor] = None,
+                 registry: Optional[MetricsRegistry] = None):
         self.num_slots = num_slots
         self.clock = clock
         self.monitor = monitor
+        self.registry = registry
         self.timers = SynchronizedWallClockTimer()
         self.ttft_s: List[float] = []
         self.tpot_s: List[float] = []
@@ -52,6 +55,31 @@ class ServingMetrics:
         self.finished: Dict[str, int] = {}
         self._start_t: Optional[float] = None
         self._end_t: Optional[float] = None
+        if registry is not None:
+            self._c_tokens = registry.counter(
+                "serving_tokens_generated_total",
+                "Tokens emitted (prefill first-tokens + decode tokens).")
+            self._c_prefills = registry.counter(
+                "serving_prefills_total", "Prefill launches (admissions).")
+            self._c_decode = registry.counter(
+                "serving_decode_steps_total", "Batched decode steps.")
+            self._c_preempt = registry.counter(
+                "serving_preemptions_total",
+                "Requests preempted back to the queue.")
+            self._g_queue = registry.gauge(
+                "serving_queue_depth", "Requests waiting for admission.")
+            self._g_active = registry.gauge(
+                "serving_active_slots", "Slots currently running a request.")
+            self._g_occ = registry.gauge(
+                "serving_slot_occupancy",
+                "Active slots / num_slots at the last decode step.")
+            self._h_ttft = registry.histogram(
+                "serving_ttft_seconds", "Time to first token.",
+                buckets=DEFAULT_LATENCY_BUCKETS)
+            self._h_tpot = registry.histogram(
+                "serving_tpot_seconds", "Time per output token (per-request "
+                "mean, recorded at finish).",
+                buckets=DEFAULT_LATENCY_BUCKETS)
 
     # ------------------------------------------------------------ #
     # recording
@@ -69,6 +97,11 @@ class ServingMetrics:
         if ttft_s is not None:
             self.ttft_s.append(ttft_s)
         self._end_t = now
+        if self.registry is not None:
+            self._c_prefills.inc()
+            self._c_tokens.inc()
+            if ttft_s is not None:
+                self._h_ttft.observe(ttft_s)
 
     def record_decode_step(self, n_active: int, queue_depth: int,
                            now: float) -> None:
@@ -79,17 +112,35 @@ class ServingMetrics:
         self.queue_depth.append(queue_depth)
         self.occupancy.append(n_active / self.num_slots)
         self._end_t = now
+        if self.registry is not None:
+            self._c_decode.inc()
+            self._c_tokens.inc(n_active)
+            self._g_queue.set(queue_depth)
+            self._g_active.set(n_active)
+            self._g_occ.set(n_active / self.num_slots)
 
     def record_preemption(self) -> None:
         self.preemptions += 1
+        if self.registry is not None:
+            self._c_preempt.inc()
 
     def record_finish(self, req, now: float) -> None:
         self.finished[req.finish_reason] = (
             self.finished.get(req.finish_reason, 0) + 1)
         self._end_t = now
         n = len(req.generated)
+        tpot = None
         if n > 1 and req.first_token_t is not None:
-            self.tpot_s.append((now - req.first_token_t) / (n - 1))
+            tpot = (now - req.first_token_t) / (n - 1)
+            self.tpot_s.append(tpot)
+        if self.registry is not None:
+            self.registry.counter(
+                "serving_requests_finished_total",
+                "Finished requests by terminal reason.",
+                labels={"reason": str(req.finish_reason)},
+            ).inc()
+            if tpot is not None:
+                self._h_tpot.observe(tpot)
 
     # ------------------------------------------------------------ #
     # reporting

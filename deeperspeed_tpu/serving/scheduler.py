@@ -29,6 +29,7 @@ import time
 from collections import deque
 from typing import Callable, Deque, List, Optional
 
+from ..monitor.tracer import trace_instant
 from ..utils.logging import logger
 from .config import ServingConfig
 from .kv_cache import NULL_BLOCK, BlockAllocator, blocks_needed
@@ -162,6 +163,9 @@ class Scheduler:
         self.slots[slot] = req
         self.slot_blocks[slot] = blocks
         self._slot_admitted_at[slot] = next(self._admit_seq)
+        trace_instant("serving/admit", lane="serving", rid=req.rid,
+                      slot=slot, ctx_len=req.cached_len,
+                      admissions=req.admissions)
         return slot, req, blocks
 
     # ---------------------------------------------------------------- #
@@ -205,6 +209,8 @@ class Scheduler:
             "serving: preempting request %s from slot %d (%d blocks freed)",
             req.rid, slot, len(self.slot_blocks[slot]),
         )
+        trace_instant("serving/preempt", lane="serving", rid=req.rid,
+                      slot=slot, blocks_freed=len(self.slot_blocks[slot]))
         self._release_slot(slot)
         req.state = QUEUED
         req.slot = -1
@@ -233,6 +239,8 @@ class Scheduler:
         req.finish_reason = reason
         req.finish_t = self.clock() if now is None else now
         self.finished.append(req)
+        trace_instant("serving/finish", lane="serving", rid=req.rid,
+                      reason=reason, tokens=len(req.generated))
 
     def check_finished(self, req: Request,
                        now: Optional[float] = None) -> bool:

@@ -8,6 +8,7 @@ falls back to a JSONL event log with the same tag/step/value records so
 headless TPU pods still get machine-readable scalars.
 """
 
+import atexit
 import json
 import os
 import time
@@ -17,11 +18,16 @@ from .logging import logger
 
 
 class TensorBoardMonitor:
+    """Usable bare or as a context manager (``with TensorBoardMonitor(...)
+    as mon:``). An atexit hook flushes buffered scalars if a run dies
+    before reaching close()."""
+
     def __init__(self, output_path: str = "", job_name: str = "DeepSpeedJobName",
                  enabled: bool = True):
         self.enabled = enabled
         self._writer = None
         self._jsonl = None
+        self._closed = False
         if not enabled:
             return
         base = os.path.join(output_path or "runs", job_name)
@@ -37,6 +43,7 @@ class TensorBoardMonitor:
                 e, path,
             )
             self._jsonl = open(path, "a")
+        atexit.register(self.flush)
 
     def add_scalar(self, tag: str, value, global_step: int):
         if not self.enabled:
@@ -54,13 +61,26 @@ class TensorBoardMonitor:
             self.add_scalar(tag, value, global_step)
 
     def flush(self):
+        if self._closed:
+            return
         if self._writer is not None:
             self._writer.flush()
         if self._jsonl is not None:
             self._jsonl.flush()
 
     def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        atexit.unregister(self.flush)
         if self._writer is not None:
             self._writer.close()
         if self._jsonl is not None:
             self._jsonl.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.close()
+        return False

@@ -163,7 +163,10 @@ class ThroughputTimer:
                         self.micro_step_count,
                         self.global_step_count,
                         self.avg_samples_per_sec(),
-                        self.batch_size / self.step_elapsed_time,
+                        # clamp like avg_samples_per_sec: a sub-resolution
+                        # step (fully async dispatch, coarse clock) must
+                        # not divide by zero
+                        self.batch_size / max(self.step_elapsed_time, 1e-12),
                     )
                 )
                 self.step_elapsed_time = 0

@@ -47,6 +47,12 @@ def main():
     ap.add_argument("--d-model", type=int, default=64)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--out", default="BENCH_serving.json")
+    ap.add_argument("--trace", default=None, metavar="PATH",
+                    help="write a Chrome-trace JSON of the run (open in "
+                         "Perfetto / chrome://tracing)")
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    help="serve Prometheus /metrics on this port for the "
+                         "duration of the run (0 = ephemeral)")
     args = ap.parse_args()
 
     from deeperspeed_tpu.models.gpt import GPTConfig, make_gpt
@@ -61,7 +67,15 @@ def main():
                          block_size=args.block_size,
                          num_blocks=args.num_blocks,
                          max_seq_len=args.max_seq_len)
-    eng = ServingEngine(cfg, params, scfg)
+    monitor_config = None
+    if args.trace is not None or args.metrics_port is not None:
+        monitor_config = {
+            "trace_path": args.trace,
+            "trace_enabled": args.trace is not None,
+            "metrics_port": args.metrics_port,
+            "watchdog": "warn",
+        }
+    eng = ServingEngine(cfg, params, scfg, monitor_config=monitor_config)
 
     # open-loop Poisson trace: arrival offsets + per-request lengths,
     # all drawn up front so the trace is reproducible from --seed
@@ -77,7 +91,10 @@ def main():
     warm = eng.submit(prompts[0], max_new_tokens=2)
     eng.run()
     assert eng.get(warm).state == "finished"
-    eng.metrics.__init__(scfg.num_slots, eng.clock)  # drop warmup stats
+    # drop warmup stats (Prometheus counters, being cumulative, keep the
+    # warmup request — the trace marks the measured-run boundary instead)
+    eng.metrics.__init__(scfg.num_slots, eng.clock,
+                         registry=eng.metrics.registry)
 
     t0 = time.monotonic()
     submitted = 0
@@ -121,6 +138,16 @@ def main():
         "prefill_compiles": eng.prefill_compile_count,
     }
     assert out["requests_finished"] == args.requests, out
+    if eng.telemetry is not None:
+        from deeperspeed_tpu.monitor import shutdown_monitor
+        from deeperspeed_tpu.monitor.validate import validate_file
+
+        if args.trace is not None:
+            out["trace"] = args.trace
+        shutdown_monitor(save=True)  # writes the trace
+        if args.trace is not None:
+            errors = validate_file(args.trace)
+            assert not errors, errors[:5]
     with open(args.out, "w") as f:
         json.dump(out, f, indent=2)
         f.write("\n")

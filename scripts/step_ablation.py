@@ -57,9 +57,17 @@ def main():
         default="base,xla_attn,ce128,dots_all",
         help="comma list: base, xla_attn, ce128, ce0, dots_all, flash_policy",
     )
+    ap.add_argument("--trace", default=None, metavar="PATH",
+                    help="write a Chrome-trace JSON with one span per "
+                         "timed variant (open in Perfetto)")
     args = ap.parse_args()
 
     from deeperspeed_tpu.models.gpt import get_preset, make_gpt
+    from deeperspeed_tpu.monitor import init_monitor, shutdown_monitor
+    from deeperspeed_tpu.monitor.tracer import trace_span
+
+    if args.trace is not None:
+        init_monitor({"trace_path": args.trace})
 
     KNOWN = ("base", "xla_attn", "ce128", "ce0", "dots_all", "flash_policy",
              "no_rotary", "no_remat")
@@ -105,10 +113,14 @@ def main():
         params = base_params
 
         fwd = jax.jit(loss_fn)
-        t_fwd = time_fn(fwd, (params, batch), args.steps)
+        with trace_span(f"ablation/{variant}/fwd", lane="engine",
+                        steps=args.steps):
+            t_fwd = time_fn(fwd, (params, batch), args.steps)
 
         grad = jax.jit(jax.value_and_grad(loss_fn))
-        t_fb = time_fn(grad, (params, batch), args.steps)
+        with trace_span(f"ablation/{variant}/fwdbwd", lane="engine",
+                        steps=args.steps):
+            t_fb = time_fn(grad, (params, batch), args.steps)
 
         out["variants"][variant] = {
             "fwd_ms": round(t_fwd * 1e3, 2),
@@ -117,6 +129,9 @@ def main():
         }
         print(variant, json.dumps(out["variants"][variant]), flush=True)
 
+    if args.trace is not None:
+        out["trace"] = args.trace
+        shutdown_monitor(save=True)
     print(json.dumps(out))
 
 
