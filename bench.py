@@ -95,11 +95,15 @@ def main():
         print(json.dumps({
             # metric name matches the success path's series so the
             # outage row appears as a gap IN that series, not as an
-            # orphaned metric downstream tooling drops
+            # orphaned metric downstream tooling drops. value is null —
+            # NOT 0: a literal zero poisons series aggregates (min /
+            # mean / regression deltas) while null is skipped by JSON-
+            # aware consumers, and the non-zero exit lets schedulers
+            # distinguish "no measurement" from "measured 0"
             "metric": f"{name}_tokens_per_sec_per_chip",
-            "value": 0, "unit": "tokens/s/chip", "vs_baseline": 0.0,
+            "value": None, "unit": "tokens/s/chip", "vs_baseline": None,
             "error": f"device unreachable: {probe_error}"}))
-        return
+        return 1
 
     import jax
 

@@ -230,8 +230,10 @@ def make_bert(cfg: BertConfig, mesh=None):
     def mlm_logits(params, sequence_output):
         cdt = cfg.dtype
         m = params["mlm"]
-        h = jax.nn.gelu(sequence_output @ m["w"].astype(cdt) + m["b"].astype(cdt),
-                        approximate=False)
+        from ..ops.pallas.fused_blocks import bias_gelu
+
+        h = bias_gelu(sequence_output @ m["w"].astype(cdt),
+                      m["b"].astype(cdt), approximate=False)
         h = _layer_norm(h, m["ln_w"], m["ln_b"], cfg.layernorm_eps)
         return h @ params["embed"]["word"].astype(cdt).T + m["bias"].astype(cdt)
 

@@ -261,11 +261,12 @@ def pick_ce_chunk(S: int, chunk: int) -> int:
 
 
 def layer_norm(x, scale, bias, eps):
-    x32 = x.astype(jnp.float32)
-    mu = jnp.mean(x32, axis=-1, keepdims=True)
-    var = jnp.mean(jnp.square(x32 - mu), axis=-1, keepdims=True)
-    y = (x32 - mu) * jax.lax.rsqrt(var + eps)
-    return (y * scale + bias).astype(x.dtype)
+    # dispatches through the "kernels" config block: fused Pallas LN on
+    # TPU when enabled, else the exact fp32-stats XLA math this function
+    # used to inline (fused_blocks._ln_ref)
+    from ..ops.pallas import fused_blocks
+
+    return fused_blocks.layer_norm(x, scale, bias, eps)
 
 
 def layer_norm2(x, scale1, bias1, scale2, bias2, eps):
@@ -344,14 +345,23 @@ def causal_attention(q, k, v, impl="auto"):
             "wires it automatically when given a mesh)"
         )
     if impl in ("auto", "pallas", "pallas_interpret"):
-        from ..ops.pallas.flash_attention import flash_attention, is_available
+        from ..ops.pallas.flash_attention import (attention_dispatch,
+                                                  flash_attention,
+                                                  is_available)
 
         if impl == "pallas_interpret":  # CPU testing path
             return flash_attention(q, k, v, causal=True, interpret=True)
         # auto avoids flash at short S: its per-(batch, head, q-block)
         # dynamic k-loop overhead beats the compute there and XLA's
         # batched-GEMM scores path is faster (hardware-measured at S<=256)
-        if impl == "pallas" or (is_available(q) and q.shape[1] > 256):
+        # — unless the "kernels" config routes the geometry to the dense
+        # super-tile kernel, which packs short sequences into MXU-sized
+        # tiles and beats the batched-GEMM path
+        B, S, H, Dh = q.shape
+        supertile = attention_dispatch(
+            (B, H, S, Dh), q.dtype.itemsize, causal=True
+        ) == "supertile"
+        if impl == "pallas" or supertile or (is_available(q) and S > 256):
             return flash_attention(q, k, v, causal=True)
     return _xla_causal_attention(q, k, v)
 
@@ -434,13 +444,15 @@ def decoder_block(cfg: GPTConfig, mesh, x, layer_params, positions, attend,
         mlp_out, moe_aux = mlp_fn(mlp_in)
         aux = (aux, moe_aux)
     else:
-        h = mlp_in @ layer_params["mlp"]["wi"].astype(cdt) + layer_params[
-            "mlp"
-        ]["bi"].astype(cdt)
+        from ..ops.pallas.fused_blocks import bias_gelu
+
+        h = mlp_in @ layer_params["mlp"]["wi"].astype(cdt)
         # pre-gelu: saving it skips the ffn-in matmul recompute while the
-        # gelu itself stays cheap to replay
+        # bias+gelu stays cheap to replay (saved pre-bias so the fused
+        # kernel owns the add)
         h = checkpoint_name(h, "mlp_pre")
-        h = jax.nn.gelu(h, approximate=True)
+        h = bias_gelu(h, layer_params["mlp"]["bi"].astype(cdt),
+                      approximate=True)
         h = _shard_act(h, mesh, P(DATA_AXIS, SEQ_AXIS, MODEL_AXIS))
         mlp_out = h @ layer_params["mlp"]["wo"].astype(cdt) + layer_params[
             "mlp"

@@ -24,6 +24,13 @@ class AdamState(NamedTuple):
     exp_avg_sq: object  # pytree like params
 
 
+def _pallas_min_size():
+    # lazy: keeps ops/adam importable without pulling in pallas
+    from .pallas.fused_adam import MIN_AUTO_SIZE
+
+    return MIN_AUTO_SIZE
+
+
 class FusedAdam:
     """Adam/AdamW over a pytree of (usually fp32 master) params.
 
@@ -48,6 +55,7 @@ class FusedAdam:
         bias_correction: bool = True,
         amsgrad: bool = False,
         state_dtype=jnp.float32,
+        use_pallas=None,
     ):
         if amsgrad:
             raise NotImplementedError("FusedAdam does not support amsgrad")
@@ -58,6 +66,10 @@ class FusedAdam:
         self.adam_w_mode = adam_w_mode
         self.bias_correction = bias_correction
         self.state_dtype = state_dtype
+        # None follows the global "kernels" config block (off by default);
+        # True forces the Pallas path (interpret mode off-TPU); False pins
+        # the XLA path regardless of config
+        self.use_pallas = use_pallas
         # (1-beta2) must be >= ~2 bf16 ulps or v updates round to zero
         self.state_dtype_sq = (
             state_dtype if (1.0 - self.betas[1]) >= 2.0 ** -7 else jnp.float32
@@ -84,8 +96,31 @@ class FusedAdam:
             ),
         )
 
-    def update(self, grads, state: AdamState, params, lr: Optional[jnp.ndarray] = None):
-        """Returns (new_params, new_state). All elementwise; jit/shard safe."""
+    def _resolve_pallas(self):
+        """(use, interpret, forced) for the Pallas leaf path at trace time."""
+        from . import kernel_config
+
+        if self.use_pallas is False:
+            return False, False, False
+        if self.use_pallas is True:
+            interp = kernel_config.get().interpret or not kernel_config._on_tpu()
+            return True, interp, True
+        use, interp = kernel_config.resolve("fused_adam")
+        return use, interp, kernel_config.get().mode == "fused"
+
+    def pallas_active(self) -> bool:
+        """Whether updates will (attempt to) run through the fused Pallas
+        kernel — lets the engine decide to request the fused cast output."""
+        return self._resolve_pallas()[0]
+
+    def update(self, grads, state: AdamState, params,
+               lr: Optional[jnp.ndarray] = None, cast_dtype=None):
+        """Returns (new_params, new_state). All elementwise; jit/shard safe.
+
+        With ``cast_dtype`` the return is (new_params, new_state, cast) —
+        ``cast`` being new_params in ``cast_dtype``. On the Pallas path the
+        cast happens inside the update kernel (no extra full-param pass);
+        the XLA path materializes it as a plain astype that XLA fuses."""
         b1, b2 = self.betas
         lr = self.lr if lr is None else lr
         step = state.step + 1
@@ -111,15 +146,48 @@ class FusedAdam:
                 upd = upd + self.weight_decay * p
             return ((p - lr * upd).astype(pdt), m_.astype(mdt), v_.astype(vdt))
 
+        use_pl, interp, forced = self._resolve_pallas()
+        n_fused = 0
+
+        def one(p, g, m, v):
+            nonlocal n_fused
+            if use_pl and (forced or p.size >= _pallas_min_size()):
+                from .pallas.fused_adam import fused_adam_leaf
+
+                r = fused_adam_leaf(
+                    p, g, m, v, lr, bc1, bc2, b1=b1, b2=b2, eps=self.eps,
+                    wd=self.weight_decay, adam_w=self.adam_w_mode,
+                    cast_dtype=cast_dtype, interpret=interp,
+                )
+                if r is not None:
+                    n_fused += 1
+                    return r
+            r = leaf(p, g, m, v)
+            if cast_dtype is not None:
+                r = r + (r[0].astype(cast_dtype),)
+            return r
+
         flat_p, treedef = jax.tree.flatten(params)
         flat_g = treedef.flatten_up_to(grads)
         flat_m = treedef.flatten_up_to(state.exp_avg)
         flat_v = treedef.flatten_up_to(state.exp_avg_sq)
-        out = [leaf(p, g, m, v) for p, g, m, v in zip(flat_p, flat_g, flat_m, flat_v)]
+        if use_pl:
+            from ..monitor.tracer import trace_span
+
+            with trace_span("kernels/fused_adam", lane="kernels",
+                            leaves=len(flat_p)):
+                out = [one(p, g, m, v) for p, g, m, v
+                       in zip(flat_p, flat_g, flat_m, flat_v)]
+        else:
+            out = [one(p, g, m, v) for p, g, m, v
+                   in zip(flat_p, flat_g, flat_m, flat_v)]
         new_p = treedef.unflatten([o[0] for o in out])
         new_m = treedef.unflatten([o[1] for o in out])
         new_v = treedef.unflatten([o[2] for o in out])
-        return new_p, AdamState(step=step, exp_avg=new_m, exp_avg_sq=new_v)
+        new_state = AdamState(step=step, exp_avg=new_m, exp_avg_sq=new_v)
+        if cast_dtype is not None:
+            return new_p, new_state, treedef.unflatten([o[3] for o in out])
+        return new_p, new_state
 
 
 class DeepSpeedCPUAdam(FusedAdam):

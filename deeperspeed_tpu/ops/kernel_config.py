@@ -1,0 +1,117 @@
+"""Process-global selection state for the fused Pallas kernel layer.
+
+The "kernels" config block (runtime/config.py) picks how the elementwise /
+optimizer / short-sequence-attention residual is executed:
+
+  off    — plain XLA everywhere (default; byte-identical to the pre-fusion
+           graphs, the safe fallback).
+  fused  — force the Pallas kernels on every supported call site. On a
+           non-TPU backend the kernels run in interpret mode so the same
+           graphs are testable under JAX_PLATFORMS=cpu.
+  auto   — Pallas on TPU when the per-surface geometry gates pass, XLA
+           otherwise. This is the production setting.
+
+Per-surface booleans (fused_blocks / fused_adam / supertile) narrow a mode
+to a subset of surfaces, e.g. {"mode": "auto", "fused_adam": False} keeps
+the optimizer on XLA while fusing layernorm/gelu and attention.
+
+The state is process-global (like the monitor tracer) because the consumers
+are free functions deep inside model code — threading a config handle
+through every layer_norm call would churn every model signature. Engines
+configure it once at init from TrainingConfig; tests use `override()`.
+"""
+
+import contextlib
+import dataclasses
+import threading
+
+MODES = ("off", "fused", "auto")
+SURFACES = ("fused_blocks", "fused_adam", "supertile")
+
+
+@dataclasses.dataclass(frozen=True)
+class KernelsConfig:
+    mode: str = "off"
+    interpret: bool = False   # force interpret-mode launches (debugging)
+    fused_blocks: bool = True
+    fused_adam: bool = True
+    supertile: bool = True
+
+
+_LOCK = threading.Lock()
+_STATE = KernelsConfig()
+
+
+def get() -> KernelsConfig:
+    return _STATE
+
+
+def _check(kwargs):
+    bad = set(kwargs) - {f.name for f in dataclasses.fields(KernelsConfig)}
+    if bad:
+        raise ValueError(f"unknown kernels config keys: {sorted(bad)}")
+    mode = kwargs.get("mode")
+    if mode is not None and mode not in MODES:
+        raise ValueError(f"kernels mode must be one of {MODES}, got {mode!r}")
+    for k in ("interpret",) + SURFACES:
+        if k in kwargs and not isinstance(kwargs[k], bool):
+            raise ValueError(f"kernels.{k} must be a bool, got {kwargs[k]!r}")
+
+
+def validate(params) -> dict:
+    """Check a "kernels" config-block dict WITHOUT touching global state
+    (runtime/config.py parses eagerly; the engine applies at init)."""
+    if not isinstance(params, dict):
+        raise ValueError('"kernels" must be a dict of KernelsConfig fields')
+    _check(params)
+    return dict(params)
+
+
+def configure(**kwargs) -> KernelsConfig:
+    """Replace fields of the global kernels config; returns the new value."""
+    global _STATE
+    _check(kwargs)
+    with _LOCK:
+        _STATE = dataclasses.replace(_STATE, **kwargs)
+        return _STATE
+
+
+@contextlib.contextmanager
+def override(**kwargs):
+    """Temporarily swap the global config (tests, scoped experiments)."""
+    global _STATE
+    with _LOCK:
+        prev = _STATE
+    try:
+        configure(**kwargs)
+        yield _STATE
+    finally:
+        with _LOCK:
+            _STATE = prev
+
+
+def _on_tpu() -> bool:
+    try:
+        import jax
+
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def resolve(surface: str):
+    """(use_pallas, interpret) decision for one surface at trace time.
+
+    `fused` forces the kernel even off-TPU by flipping to interpret mode
+    (slow, but the graph under test is the real kernel); `auto` only fires
+    on TPU. Geometry gates are the caller's job — this answers "does the
+    config want Pallas here", not "does the shape fit".
+    """
+    st = _STATE
+    if surface not in SURFACES:
+        raise ValueError(f"unknown kernel surface {surface!r}")
+    if st.mode == "off" or not getattr(st, surface):
+        return False, False
+    if st.mode == "fused":
+        return True, st.interpret or not _on_tpu()
+    return _on_tpu(), st.interpret

@@ -72,9 +72,11 @@ def _compiler_params(interpret, n_parallel, semantics=None):
 
 
 def _auto_block(S, default):
-    """Largest multiple-of-128 block <= default that divides S; whole-S
-    block as the fallback (a block equal to the full dim always tiles, but
-    only fits VMEM for small S — is_available gates the auto path on that).
+    """Largest multiple-of-128 block <= default that divides S. When no
+    divisor exists: whole-S for short sequences (a block equal to the full
+    dim always tiles), else the largest 128-multiple <= default and the
+    kernels run a masked tail (the final partial block is index-clamped and
+    the out-of-range columns/rows masked — see the ragged paths below).
 
     Multiple of 128, not 8: block_q is also the LANE dim of the lse/delta
     BlockSpecs, and lane-dim blocks must be 128-divisible or span the full
@@ -83,7 +85,9 @@ def _auto_block(S, default):
     for d in range(b - b % 128, 127, -128):
         if S % d == 0:
             return d
-    return S
+    if S <= default:
+        return S
+    return default - default % 128 if default >= 128 else S
 
 
 
@@ -130,6 +134,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, block_k,
     bq = q.shape[0]
     qi = pl.program_id(2)
     q_start = qi * bq
+    # ragged tail (block_k does not divide S): the last k block's read is
+    # clamped to start at S - block_k (an in-bounds window that OVERLAPS the
+    # previous block) and the already-processed overlap columns are masked
+    # out, so every column is counted exactly once
+    ragged = seq_len % block_k != 0
+    nk = pl.cdiv(seq_len, block_k)
 
     m0 = jnp.full((bq,), NEG_INF, jnp.float32)
     l0 = jnp.zeros((bq,), jnp.float32)
@@ -138,18 +148,24 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, block_k,
     def make_body(masked):
         def body(kb, carry):
             m, l, acc = carry
-            k = k_ref[0, 0, pl.ds(kb * block_k, block_k), :]
-            v = v_ref[0, 0, pl.ds(kb * block_k, block_k), :]
+            start = kb * block_k
+            if ragged:
+                start = jnp.minimum(start, seq_len - block_k)
+            k = k_ref[0, 0, pl.ds(start, block_k), :]
+            v = v_ref[0, 0, pl.ds(start, block_k), :]
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
             ) * sm_scale  # (BQ, BK) fp32
             if masked:
                 rows = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-                cols = kb * block_k + jax.lax.broadcasted_iota(
-                    jnp.int32, s.shape, 1
-                )
-                s = jnp.where(rows >= cols, s, NEG_INF)
+                cols = start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+                valid = jnp.full(s.shape, True)
+                if causal:
+                    valid = rows >= cols
+                if ragged:
+                    valid &= cols >= kb * block_k
+                s = jnp.where(valid, s, NEG_INF)
             m_new = jnp.maximum(m, jnp.max(s, axis=-1))
             p = jnp.exp(s - m_new[:, None])
             alpha = jnp.exp(m - m_new)
@@ -162,25 +178,33 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, block_k,
 
         return body
 
-    if causal:
+    if causal and not ragged:
         # blocks strictly below the diagonal need no mask; the (at most
-        # ceil(bq/bk)+1) blocks straddling it do
-        num_full = q_start // block_k
-        num_all = pl.cdiv(q_start + bq, block_k)
+        # ceil(bq/bk)+1) blocks straddling it do. Bounds are clamped to nk
+        # for the padded tail q block (q_start may exceed S there).
+        num_full = jnp.minimum(q_start // block_k, nk)
+        num_all = jnp.minimum(pl.cdiv(q_start + bq, block_k), nk)
         carry = jax.lax.fori_loop(0, num_full, make_body(False),
                                   (m0, l0, acc0))
         m, l, acc = jax.lax.fori_loop(num_full, num_all, make_body(True),
                                       carry)
+    elif causal:
+        num_all = jnp.minimum(pl.cdiv(q_start + bq, block_k), nk)
+        m, l, acc = jax.lax.fori_loop(0, num_all, make_body(True),
+                                      (m0, l0, acc0))
     else:
-        m, l, acc = jax.lax.fori_loop(0, seq_len // block_k,
-                                      make_body(False), (m0, l0, acc0))
+        carry = jax.lax.fori_loop(0, seq_len // block_k,
+                                  make_body(False), (m0, l0, acc0))
+        if ragged:
+            carry = make_body(True)(nk - 1, carry)
+        m, l, acc = carry
     o_ref[0, 0] = (acc / l[:, None]).astype(o_ref.dtype)
     lse_ref[0, 0, 0] = m + jnp.log(l)
 
 
 def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
     B, H, S, Dh = q.shape
-    grid = (B, H, S // block_q)
+    grid = (B, H, pl.cdiv(S, block_q))
 
     kernel = functools.partial(
         _fwd_kernel, sm_scale=sm_scale, block_k=block_k, seq_len=S, causal=causal
@@ -219,6 +243,11 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, o_lse_ref, delta_ref,
     bk = k.shape[0]
     ki = pl.program_id(2)
     k_start = ki * bk
+    # ragged q tail: clamp the window like the fwd kernel's k reads and
+    # mask the overlap ROWS (the clamped lse/delta reads stay in bounds, so
+    # the masked p is exactly 0 — no NaN enters the dk/dv dots)
+    ragged = seq_len % block_q != 0
+    nq_all = pl.cdiv(seq_len, block_q)
 
     dk0 = jnp.zeros((bk, k.shape[1]), jnp.float32)
     dv0 = jnp.zeros((bk, v.shape[1]), jnp.float32)
@@ -227,20 +256,26 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, o_lse_ref, delta_ref,
     def make_body(masked):
         def body(qb, carry):
             dk, dv = carry
-            q = q_ref[0, 0, pl.ds(qb * block_q, block_q), :]
-            do = do_ref[0, 0, pl.ds(qb * block_q, block_q), :]
-            lse = o_lse_ref[0, 0, 0, pl.ds(qb * block_q, block_q)]
-            delta = delta_ref[0, 0, 0, pl.ds(qb * block_q, block_q)]
+            start = qb * block_q
+            if ragged:
+                start = jnp.minimum(start, seq_len - block_q)
+            q = q_ref[0, 0, pl.ds(start, block_q), :]
+            do = do_ref[0, 0, pl.ds(start, block_q), :]
+            lse = o_lse_ref[0, 0, 0, pl.ds(start, block_q)]
+            delta = delta_ref[0, 0, 0, pl.ds(start, block_q)]
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
             ) * sm_scale  # (BQ, BK)
             if masked:
-                rows = qb * block_q + jax.lax.broadcasted_iota(
-                    jnp.int32, s.shape, 0
-                )
+                rows = start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
                 cols = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-                s = jnp.where(rows >= cols, s, NEG_INF)
+                valid = jnp.full(s.shape, True)
+                if causal:
+                    valid = rows >= cols
+                if ragged:
+                    valid &= rows >= qb * block_q
+                s = jnp.where(valid, s, NEG_INF)
             p = jnp.exp(s - lse[:, None])  # (BQ, BK) fp32
             pc = p.astype(do.dtype)
             dv_new = dv + jax.lax.dot_general(
@@ -260,7 +295,7 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, o_lse_ref, delta_ref,
 
         return body
 
-    if causal:
+    if causal and not ragged:
         # q blocks strictly past this k block are unmasked; the straddling
         # blocks need the in-block mask
         start_qb = k_start // block_q
@@ -268,8 +303,15 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, o_lse_ref, delta_ref,
         carry = jax.lax.fori_loop(start_qb, jnp.minimum(full_from, num_qb),
                                   make_body(True), (dk0, dv0))
         dk, dv = jax.lax.fori_loop(full_from, num_qb, make_body(False), carry)
+    elif causal:
+        start_qb = jnp.minimum(k_start // block_q, nq_all)
+        dk, dv = jax.lax.fori_loop(start_qb, nq_all, make_body(True),
+                                   (dk0, dv0))
     else:
-        dk, dv = jax.lax.fori_loop(0, num_qb, make_body(False), (dk0, dv0))
+        carry = jax.lax.fori_loop(0, num_qb, make_body(False), (dk0, dv0))
+        if ragged:
+            carry = make_body(True)(nq_all - 1, carry)
+        dk, dv = carry
     dk_ref[0, 0] = dk.astype(dk_ref.dtype)
     dv_ref[0, 0] = dv.astype(dv_ref.dtype)
 
@@ -283,23 +325,31 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_lse_ref, delta_ref, dq_ref,
     bq = q.shape[0]
     qi = pl.program_id(2)
     q_start = qi * bq
+    ragged = seq_len % block_k != 0  # same clamp+overlap-mask as the fwd
+    nk = pl.cdiv(seq_len, block_k)
 
     dq0 = jnp.zeros((bq, q.shape[1]), jnp.float32)
 
     def make_body(masked):
         def body(kb, dq):
-            k = k_ref[0, 0, pl.ds(kb * block_k, block_k), :]
-            v = v_ref[0, 0, pl.ds(kb * block_k, block_k), :]
+            start = kb * block_k
+            if ragged:
+                start = jnp.minimum(start, seq_len - block_k)
+            k = k_ref[0, 0, pl.ds(start, block_k), :]
+            v = v_ref[0, 0, pl.ds(start, block_k), :]
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
             ) * sm_scale
             if masked:
                 rows = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-                cols = kb * block_k + jax.lax.broadcasted_iota(
-                    jnp.int32, s.shape, 1
-                )
-                s = jnp.where(rows >= cols, s, NEG_INF)
+                cols = start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+                valid = jnp.full(s.shape, True)
+                if causal:
+                    valid = rows >= cols
+                if ragged:
+                    valid &= cols >= kb * block_k
+                s = jnp.where(valid, s, NEG_INF)
             p = jnp.exp(s - lse[:, None])
             dp = jax.lax.dot_general(
                 do, v, (((1,), (1,)), ((), ())),
@@ -313,13 +363,18 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_lse_ref, delta_ref, dq_ref,
 
         return body
 
-    if causal:
-        num_full = q_start // block_k
-        num_all = pl.cdiv(q_start + bq, block_k)
+    if causal and not ragged:
+        num_full = jnp.minimum(q_start // block_k, nk)
+        num_all = jnp.minimum(pl.cdiv(q_start + bq, block_k), nk)
         dq = jax.lax.fori_loop(0, num_full, make_body(False), dq0)
         dq = jax.lax.fori_loop(num_full, num_all, make_body(True), dq)
+    elif causal:
+        num_all = jnp.minimum(pl.cdiv(q_start + bq, block_k), nk)
+        dq = jax.lax.fori_loop(0, num_all, make_body(True), dq0)
     else:
         dq = jax.lax.fori_loop(0, seq_len // block_k, make_body(False), dq0)
+        if ragged:
+            dq = make_body(True)(nk - 1, dq)
     dq_ref[0, 0] = dq.astype(dq_ref.dtype)
 
 
@@ -338,7 +393,7 @@ def _flash_bwd(res, g, sm_scale, causal, block_q, block_k, interpret):
     )
     dk, dv = pl.pallas_call(
         dkdv,
-        grid=(B, H, S // block_k),
+        grid=(B, H, pl.cdiv(S, block_k)),
         in_specs=[
             _vmem_spec((1, 1, S, Dh), lambda b, h, i: (b, h, 0, 0)),  # q
             _vmem_spec((1, 1, block_k, Dh), lambda b, h, i: (b, h, i, 0)),  # k
@@ -365,7 +420,7 @@ def _flash_bwd(res, g, sm_scale, causal, block_q, block_k, interpret):
     )
     dq = pl.pallas_call(
         dqk,
-        grid=(B, H, S // block_q),
+        grid=(B, H, pl.cdiv(S, block_q)),
         in_specs=[
             _vmem_spec((1, 1, block_q, Dh), lambda b, h, i: (b, h, i, 0)),  # q
             _vmem_spec((1, 1, S, Dh), lambda b, h, i: (b, h, 0, 0)),  # k
@@ -417,17 +472,54 @@ _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
 def _resolve_blocks(S, block_q, block_k):
-    """Explicit block sizes must divide S; auto-selected ones always do."""
+    """Block sizes need not divide S: the kernels run a masked tail for the
+    final partial block (clamped window + overlap mask). Sequences shorter
+    than a requested block clamp the block to S."""
     if block_q is None:
         block_q = _auto_block(S, DEFAULT_BLOCK_Q)
     if block_k is None:
         block_k = _auto_block(S, DEFAULT_BLOCK_K)
     block_q = min(block_q, S)
     block_k = min(block_k, S)
-    assert S % block_q == 0 and S % block_k == 0, (
-        f"seq len {S} must be divisible by block sizes ({block_q}, {block_k})"
-    )
     return block_q, block_k
+
+
+def attention_dispatch(shape, itemsize=2, causal=True, interpret=False,
+                       mode=None, platform=None):
+    """Decide which attention implementation a (B, H, S, Dh) geometry gets:
+    'supertile' | 'static' | 'stream' | 'xla'.
+
+    ``mode`` defaults to the global "kernels" config block; ``platform``
+    defaults to the detected backend. Both are injectable so the dispatch
+    decision itself is testable on CPU (the acceptance test pins
+    platform='tpu' and asserts the BERT short-seq geometry routes to the
+    super-tile kernel under mode 'auto').
+
+    'xla' is advisory for model-level callers (flash_attention_bhsd itself
+    never falls back — callers gate on is_available and friends)."""
+    from ..kernel_config import get as _kernels_config
+    from .flash_static import (MAX_STATIC_SEQ, supertile_geometry_ok)
+
+    B, H, S, Dh = shape
+    kc = _kernels_config()
+    if mode is None:
+        mode = kc.mode if kc.supertile else "off"
+    if platform is None:
+        try:
+            platform = jax.devices()[0].platform
+        except Exception:  # pragma: no cover
+            platform = "cpu"
+    on_tpu = platform == "tpu"
+    if mode == "fused" or (mode == "auto" and on_tpu):
+        if supertile_geometry_ok(B, H, S, Dh, itemsize):
+            return "supertile"
+    if interpret:
+        return "stream"  # CPU tests target the v1 streaming blocks
+    if not on_tpu:
+        return "xla"
+    if S <= MAX_STATIC_SEQ and S >= 8 and S % 8 == 0 and Dh % 8 == 0:
+        return "static"
+    return "stream"
 
 
 def flash_attention_bhsd(
@@ -445,18 +537,36 @@ def flash_attention_bhsd(
     This is the layout the kernels run in; callers that already hold
     head-major tensors avoid the boundary transposes.
 
-    Dispatch: short/mid sequences route to the static-unrolled resident
-    kernel (flash_static.py — hardware-measured 78 vs 45 TF at the 1.3B
+    Dispatch (attention_dispatch): short sequences pack into the dense
+    super-tile kernel when the "kernels" config block enables it;
+    short/mid sequences route to the static-unrolled resident kernel
+    (flash_static.py — hardware-measured 78 vs 45 TF at the 1.3B
     geometry); explicit block sizes or long S keep the v1 streaming
-    kernel. interpret=True also keeps v1 (CPU tests target its blocks)."""
+    kernel. interpret=True keeps v1 (CPU tests target its blocks) unless
+    the kernels config forces the super-tile path."""
     B, H, S, Dh = q.shape
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(Dh)
-    if block_q is None and block_k is None and not interpret:
+    if block_q is None and block_k is None:
+        from ..kernel_config import resolve as _resolve_kernels
         from .flash_static import (flash_attention_static_bhsd,
+                                   flash_attention_supertile_bhsd,
                                    is_static_available)
+        from ...monitor.tracer import trace_instant
 
-        if is_static_available(q):
+        decision = attention_dispatch(q.shape, q.dtype.itemsize,
+                                      causal=causal, interpret=interpret)
+        if decision == "supertile":
+            trace_instant("kernels/attention_dispatch", lane="kernels",
+                          impl="supertile", shape=list(q.shape),
+                          causal=causal)
+            st_interpret = interpret or _resolve_kernels("supertile")[1]
+            return flash_attention_supertile_bhsd(
+                q, k, v, causal=causal, sm_scale=sm_scale,
+                interpret=st_interpret)
+        if decision == "static" and not interpret and is_static_available(q):
+            trace_instant("kernels/attention_dispatch", lane="kernels",
+                          impl="static", shape=list(q.shape), causal=causal)
             return flash_attention_static_bhsd(q, k, v, causal=causal,
                                                sm_scale=sm_scale)
     block_q, block_k = _resolve_blocks(S, block_q, block_k)

@@ -40,7 +40,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax.ad_checkpoint import checkpoint_name
 
-from ..pallas.flash_attention import flash_attention, is_available
+from ..pallas.flash_attention import (attention_dispatch, flash_attention,
+                                      is_available)
+from ..pallas.fused_blocks import add_layer_norm, bias_gelu, layer_norm
 
 
 class TransformerConfig:
@@ -160,10 +162,10 @@ def init_transformer_params(rng, config: DeepSpeedTransformerConfig):
 
 
 def _layer_norm(x, w, b, eps=1e-12):
-    x32 = x.astype(jnp.float32)
-    mu = jnp.mean(x32, axis=-1, keepdims=True)
-    var = jnp.var(x32, axis=-1, keepdims=True)
-    return ((x32 - mu) * jax.lax.rsqrt(var + eps) * w + b).astype(x.dtype)
+    # dispatches through the "kernels" config block (ops/kernel_config.py);
+    # the XLA fallback is the exact fp32-stats math this function used to
+    # inline
+    return layer_norm(x, w, b, eps)
 
 
 def _dropout(x, ratio, rng):
@@ -189,10 +191,20 @@ def _attention_core(q, k, v, config, attention_mask, drop_rng=None):
         # short sequences: flash's grid runs one k-block per (batch, head,
         # q-block) and the dynamic-loop scalar overhead dominates (~1.7 TF
         # at S=128 vs XLA's batched-GEMM path — hardware-measured, BERT
-        # seq128 +27% end-to-end); the dense scores tensor is tiny there
+        # seq128 +27% end-to-end); the dense scores tensor is tiny there —
+        # unless the "kernels" config routes the geometry to the dense
+        # super-tile kernel, which packs short sequences into MXU-sized
+        # tiles and closes exactly that gap
         short = q.shape[1] <= 256
-        impl = ("flash" if (not needs_probs and not short
-                            and _flash_ok(q, config)) else "xla")
+        B_, S_, nh_, dh_ = q.shape
+        supertile = (not needs_probs) and attention_dispatch(
+            (B_, nh_, S_, dh_), q.dtype.itemsize, causal=False,
+            interpret=config.interpret,
+        ) == "supertile"
+        impl = ("flash" if (not needs_probs and
+                            (supertile
+                             or (not short and _flash_ok(q, config))))
+                else "xla")
     if impl == "flash" and needs_probs:
         raise ValueError(
             "flash attn_impl supports neither attention_mask nor attention "
@@ -255,8 +267,10 @@ def _transformer_forward(params, x, config: DeepSpeedTransformerConfig,
 
     def ffn_block(x):
         h = _layer_norm(x, p["norm_w"], p["norm_b"], eps) if config.pre_layer_norm else x
-        pre = checkpoint_name(h @ p["inter_w"] + p["inter_b"], "bert_mlp_pre")
-        inter = jax.nn.gelu(pre, approximate=False)
+        # saved pre-bias so the fused kernel owns the bias add; the XLA
+        # fallback (gelu(x + b)) is the exact pre-fusion math
+        pre = checkpoint_name(h @ p["inter_w"], "bert_mlp_pre")
+        inter = bias_gelu(pre, p["inter_b"], approximate=False)
         out = inter @ p["output_w"] + p["output_b"]
         return _dropout(out, config.hidden_dropout_ratio, r3)
 
@@ -272,8 +286,9 @@ def _transformer_forward(params, x, config: DeepSpeedTransformerConfig,
         if config.pre_layer_norm:
             x = x + attn_block(x)
             return x + ffn_block(x)
-        x = _layer_norm(x + attn_block(x), p["attn_nw"], p["attn_nb"], eps)
-        return _layer_norm(x + ffn_block(x), p["norm_w"], p["norm_b"], eps)
+        # post-LN add&norm fuses the residual add into the LN kernel
+        x = add_layer_norm(attn_block(x), x, p["attn_nw"], p["attn_nb"], eps)
+        return add_layer_norm(ffn_block(x), x, p["norm_w"], p["norm_b"], eps)
 
     if config.stochastic_mode and pld_theta is not None and gate_rng is not None:
         gate = jax.random.bernoulli(gate_rng, pld_theta).astype(dtype)

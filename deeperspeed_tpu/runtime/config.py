@@ -327,6 +327,26 @@ class TrainingConfig:
             except ValueError as e:
                 raise ConfigError(f'invalid "monitor" block: {e}') from e
 
+        # ---- fused Pallas kernels ----
+        # A "kernels" block selects the fused elementwise/optimizer/
+        # super-tile attention kernels (ops/kernel_config.py): mode
+        # off (XLA, default) | fused | auto, plus per-surface booleans.
+        # Validated eagerly so typos fail at load; applied process-
+        # globally at engine init (the consumers are free functions deep
+        # inside model code).
+        self.kernels_params = pd.get(c.KERNELS, None)
+        self.kernels_mode = c.KERNELS_MODE_DEFAULT
+        if self.kernels_params is not None:
+            from ..ops import kernel_config
+
+            try:
+                self.kernels_params = kernel_config.validate(
+                    self.kernels_params)
+            except ValueError as e:
+                raise ConfigError(f'invalid "kernels" block: {e}') from e
+            self.kernels_mode = self.kernels_params.get(
+                c.KERNELS_MODE, c.KERNELS_MODE_DEFAULT)
+
         bs_sched = pd.get(c.BATCH_SCHEDULER, {})
         if isinstance(bs_sched, dict):
             self.batch_scheduler_enabled = bs_sched.get(

@@ -232,3 +232,15 @@ SERVING_ENABLED_DEFAULT = False
 MONITOR = "monitor"
 MONITOR_ENABLED = "enabled"
 MONITOR_ENABLED_DEFAULT = False
+
+#############################################
+# Fused Pallas kernel selection (ops/kernel_config.py): fused
+# elementwise/optimizer blocks and the dense super-tile flash kernel.
+# mode: "off" (XLA everywhere — the pre-fusion graphs, default) |
+# "fused" (always launch the kernels; interpret mode off-TPU) |
+# "auto" (kernels on TPU, XLA elsewhere). Per-surface booleans
+# (fused_blocks / fused_adam / supertile) opt individual kernels out.
+#############################################
+KERNELS = "kernels"
+KERNELS_MODE = "mode"
+KERNELS_MODE_DEFAULT = "off"

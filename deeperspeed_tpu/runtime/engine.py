@@ -194,6 +194,16 @@ class Engine(ConfigAccessorsMixin):
             self.monitor = init_monitor(config.monitor_config())
         else:
             self.monitor = get_monitor()
+        # fused Pallas kernels: the "kernels" config block selects the
+        # fused elementwise/optimizer/super-tile kernels. Applied
+        # process-globally (ops/kernel_config.py) because the consumers
+        # are free functions deep inside model code; must land before
+        # _configure_basic_optimizer so FusedAdam sees the mode.
+        if getattr(config, "kernels_params", None):
+            from ..ops.kernel_config import configure as _configure_kernels
+
+            _configure_kernels(**config.kernels_params)
+
         # the fused train step legitimately traces twice: the initial
         # state is an uncommitted single-device array, the step's output
         # commits to a NamedSharding over the mesh, and the second call
@@ -830,7 +840,17 @@ class Engine(ConfigAccessorsMixin):
         overflow = ~finite
 
         target = state.master if self._use_master else state.params
-        new_target, new_opt = opt.update(grads, state.opt_state, target, lr)
+        # with the fused Pallas Adam active, the fp32->compute-dtype
+        # master-weight cast rides inside the optimizer kernel (one HBM
+        # pass) instead of a separate full-param cast here
+        fused_cast = (self._use_master
+                      and getattr(opt, "pallas_active", lambda: False)())
+        if fused_cast:
+            new_target, new_opt, new_cast = opt.update(
+                grads, state.opt_state, target, lr,
+                cast_dtype=self._compute_dtype)
+        else:
+            new_target, new_opt = opt.update(grads, state.opt_state, target, lr)
         keep = lambda new, old: jax.tree.map(
             lambda n, o: jnp.where(overflow, o, n), new, old
         )
@@ -841,11 +861,16 @@ class Engine(ConfigAccessorsMixin):
             new_master = None
         else:
             new_master = partition.constrain(new_target, self.master_specs, self.mesh)
+            if fused_cast:
+                # overflow keep-select vs the old compute-dtype params —
+                # identical to casting keep(master): params == cast(master)
+                # is the steady-state invariant
+                cast = keep(new_cast, state.params)
+            else:
+                cast = jax.tree.map(
+                    lambda m: m.astype(self._compute_dtype), new_master)
             new_params = partition.constrain(
-                jax.tree.map(lambda m: m.astype(self._compute_dtype), new_master),
-                self.param_specs,
-                self.mesh,
-            )
+                cast, self.param_specs, self.mesh)
         new_state = EngineState(
             step=state.step + jnp.where(overflow, 0, 1),
             params=new_params,

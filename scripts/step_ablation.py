@@ -11,6 +11,12 @@ isolated units) under controlled variants:
 The fwd/bwd split shows whether the gap is forward elementwise (paid once)
 or backward replay (paid under remat). Usage:
   python scripts/step_ablation.py [--micro 2] [--seq 1024] [--steps 20]
+
+--floor MFU_DECOMP.json additionally prints the composite-unit floor for
+the preset and each variant's residual (measured fwdbwd − floor): the ms
+the framework pays above raw matmul+attention+head compute. This is the
+number the fused kernel layer (ops/pallas/fused_blocks.py etc.) exists to
+shrink — rerun with and without the "kernels" block and diff residuals.
 """
 
 import argparse
@@ -60,6 +66,10 @@ def main():
     ap.add_argument("--trace", default=None, metavar="PATH",
                     help="write a Chrome-trace JSON with one span per "
                          "timed variant (open in Perfetto)")
+    ap.add_argument("--floor", default=None, metavar="MFU_DECOMP.json",
+                    help="print the composite-unit floor for this preset "
+                         "and each variant's residual (fwdbwd_ms - "
+                         "micro_step_floor_ms)")
     args = ap.parse_args()
 
     from deeperspeed_tpu.models.gpt import get_preset, make_gpt
@@ -129,10 +139,62 @@ def main():
         }
         print(variant, json.dumps(out["variants"][variant]), flush=True)
 
+    if args.floor is not None:
+        _print_floor_residuals(args, out)
+
     if args.trace is not None:
         out["trace"] = args.trace
         shutdown_monitor(save=True)
     print(json.dumps(out))
+
+
+# preset name -> MFU_DECOMP.json top-level key; unlisted presets are
+# looked up by their own name so new decomp entries need no code change
+_FLOOR_PRESET_KEYS = {"neox-1.3b": "1.3b"}
+
+
+def _print_floor_residuals(args, out):
+    with open(args.floor) as f:
+        decomp = json.load(f)
+    key = _FLOOR_PRESET_KEYS.get(args.preset, args.preset)
+    if key not in decomp or "micro_step_floor_ms" not in decomp[key]:
+        known = sorted(k for k, v in decomp.items()
+                       if isinstance(v, dict) and "micro_step_floor_ms" in v)
+        raise SystemExit(
+            f"--floor: no floor entry {key!r} in {args.floor}; "
+            f"available: {known}")
+    entry = decomp[key]
+    floor_ms = entry["micro_step_floor_ms"]
+    units = entry.get("units_fwdbwd", {})
+    # floor = L * (matmul chain + attention) + vocab head; recover L so
+    # the per-unit composition prints in step-ms, not per-layer-ms
+    per_layer = (units.get("layer_matmul_chain", {}).get("ms", 0.0)
+                 + units.get("attention_core", {}).get("ms", 0.0))
+    head_ms = units.get("vocab_head", {}).get("ms", 0.0)
+    layers = round((floor_ms - head_ms) / per_layer) if per_layer else 0
+    print(f"floor[{key}]: micro_step_floor_ms={floor_ms} "
+          f"({entry.get('micro_step_floor_tflops')} TF on "
+          f"{entry.get('device')})")
+    for name, u in units.items():
+        detail = ""
+        if "impl" in u:
+            detail = f" impl={u['impl']} geometry={tuple(u['geometry'])}"
+        mult = f" x {layers} layers" if name != "vocab_head" else ""
+        print(f"  unit {name}:{detail} {u.get('ms')} ms{mult} "
+              f"({u.get('tflops')} TF)")
+    if out["platform"] != entry.get("platform", "tpu"):
+        print(f"  NOTE: floor measured on {entry.get('platform')!r} but "
+              f"this run is on {out['platform']!r} — residuals are not "
+              "meaningful off-device")
+    out["floor"] = {"key": key, "micro_step_floor_ms": floor_ms,
+                    "layers": layers}
+    for variant, r in out["variants"].items():
+        resid = r["fwdbwd_ms"] - floor_ms
+        r["residual_ms"] = round(resid, 2)
+        r["residual_frac"] = round(resid / floor_ms, 4)
+        print(f"residual {variant}: {r['fwdbwd_ms']} ms fwdbwd - "
+              f"{floor_ms} ms floor = {r['residual_ms']:+.2f} ms "
+              f"({100 * r['residual_frac']:+.1f}% of floor)")
 
 
 if __name__ == "__main__":

@@ -168,6 +168,56 @@ def main():
     _check("sparse skewed+empty rows/cols fwd+bwd",
            jax.jit(skewed_check))
 
+    # ---- fused elementwise blocks ------------------------------------- #
+    from deeperspeed_tpu.ops import kernel_config
+    from deeperspeed_tpu.ops.pallas import fused_blocks
+
+    with kernel_config.override(mode="fused"):
+        for dtype, tag in ((jnp.float32, "fp32"), (jnp.bfloat16, "bf16")):
+            x = jax.random.normal(jax.random.PRNGKey(7), (1024, 768), dtype)
+            r = jax.random.normal(jax.random.PRNGKey(8), (1024, 768), dtype)
+            w = jnp.ones((768,), jnp.float32)
+            b = jnp.zeros((768,), jnp.float32)
+            _check(f"fused layer_norm {tag} fwd+bwd",
+                   jax.jit(lambda x=x, w=w, b=b: jax.grad(
+                       lambda x: (fused_blocks.layer_norm(x, w, b, 1e-5)
+                                  .astype(jnp.float32) ** 2).sum())(x)))
+            _check(f"fused add_layer_norm {tag} fwd+bwd",
+                   jax.jit(lambda x=x, r=r, w=w, b=b: jax.grad(
+                       lambda x: (fused_blocks.add_layer_norm(x, r, w, b, 1e-5)
+                                  .astype(jnp.float32) ** 2).sum())(x)))
+            h = jax.random.normal(jax.random.PRNGKey(9), (2048, 1536), dtype)
+            hb = jax.random.normal(jax.random.PRNGKey(10), (1536,), dtype)
+            for approx in (True, False):
+                _check(f"fused bias_gelu {tag} approx={approx} fwd+bwd",
+                       jax.jit(lambda h=h, hb=hb, a=approx: jax.grad(
+                           lambda h: (fused_blocks.bias_gelu(h, hb, a)
+                                      .astype(jnp.float32) ** 2).sum())(h)))
+
+    # ---- fused Adam ---------------------------------------------------- #
+    from deeperspeed_tpu.ops.pallas.fused_adam import fused_adam_leaf
+
+    p = jax.random.normal(jax.random.PRNGKey(11), (512, 2048), jnp.float32)
+    g = jax.random.normal(jax.random.PRNGKey(12), (512, 2048), jnp.float32)
+    m = jnp.zeros_like(p)
+    v = jnp.zeros_like(p)
+    _check("fused adam (adamw + bf16 cast)",
+           jax.jit(lambda: fused_adam_leaf(
+               p, g, m, v, 1e-3, 0.9, 0.95, b1=0.9, b2=0.95, eps=1e-8,
+               wd=0.01, adam_w=True, cast_dtype=jnp.bfloat16)))
+
+    # ---- dense super-tile flash ---------------------------------------- #
+    from deeperspeed_tpu.ops.pallas.flash_static import (
+        flash_attention_supertile_bhsd)
+
+    for shape, causal in (((4, 2, 64, 64), True),
+                          ((64, 16, 128, 64), False)):  # bert128 geometry
+        q = jax.random.normal(jax.random.PRNGKey(13), shape, jnp.bfloat16)
+        _check(f"supertile {shape} causal={causal} fwd+bwd",
+               jax.jit(lambda q=q, c=causal: jax.grad(
+                   lambda q: (flash_attention_supertile_bhsd(q, q, q, causal=c)
+                              .astype(jnp.float32) ** 2).sum())(q)))
+
     # ---- fused transformer layer -------------------------------------- #
     from deeperspeed_tpu.ops.transformer import (
         DeepSpeedTransformerConfig, DeepSpeedTransformerLayer)
