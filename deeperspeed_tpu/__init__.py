@@ -29,6 +29,13 @@ from .module_inject import replace_transformer_layer, module_inject
 from .utils import logger, log_dist
 from .utils.distributed import init_distributed
 from .serving import PipelineServingBridge, ServingConfig, ServingEngine
+from .resilience import (
+    ResilienceConfig,
+    ResilienceManager,
+    get_resilience_manager,
+    init_resilience,
+    shutdown_resilience,
+)
 
 
 def add_config_arguments(parser):

@@ -46,6 +46,22 @@ def to_host(tree):
     return jax.tree.map(leaf, tree)
 
 
+def _fsync_dir(path: str):
+    """fsync a directory so renames/creates inside it survive power
+    loss; silently skipped where the platform refuses the open."""
+    flags = os.O_RDONLY | getattr(os, "O_DIRECTORY", 0)
+    try:
+        fd = os.open(path, flags)
+    except OSError:  # pragma: no cover - exotic filesystems
+        return
+    try:
+        os.fsync(fd)
+    except OSError:  # pragma: no cover
+        pass
+    finally:
+        os.close(fd)
+
+
 def save_tree(path: str, tree: Any):
     parent = os.path.dirname(path)
     if parent:
@@ -54,7 +70,11 @@ def save_tree(path: str, tree: Any):
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, path)
+    if parent:
+        _fsync_dir(parent)
 
 
 def load_tree(path: str, target: Optional[Any] = None):
@@ -66,11 +86,17 @@ def load_tree(path: str, target: Optional[Any] = None):
 
 
 def write_latest(save_dir: str, tag: str):
+    """Atomically repoint ``latest``. The temp file is fsynced before
+    the rename and the directory after it, so the pointer survives
+    power loss — not just process death — and never reads torn."""
     os.makedirs(save_dir, exist_ok=True)
     tmp = os.path.join(save_dir, LATEST_FILE + ".tmp")
     with open(tmp, "w") as f:
         f.write(tag)
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, os.path.join(save_dir, LATEST_FILE))
+    _fsync_dir(save_dir)
 
 
 def read_latest(load_dir: str) -> Optional[str]:

@@ -4,6 +4,7 @@ from .elasticity import (
     ElasticityConfigError,
     ElasticityIncompatibleWorldSize,
     compute_elastic_config,
+    elastic_world_sizes,
     ensure_immutable_elastic_config,
 )
 from . import constants

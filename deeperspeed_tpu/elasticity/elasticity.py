@@ -153,6 +153,24 @@ def compute_elastic_config(ds_config, target_deepspeed_version=None, world_size=
     return final_batch_size, valid_gpus
 
 
+def elastic_world_sizes(ds_config):
+    """Valid world sizes for a config with an elasticity block, [] when
+    the block is absent/disabled or unsatisfiable. The resilience
+    supervisor exports these to restarted children so a resume on a
+    shrunken TPU pool can pick a compatible chip count without
+    re-deriving the elastic schedule."""
+    if not isinstance(ds_config, dict):
+        return []
+    elastic_dict = ds_config.get(ec.ELASTICITY, {})
+    if not elastic_dict.get(ec.ENABLED, ec.ENABLED_DEFAULT):
+        return []
+    try:
+        _batch, valid_gpus = compute_elastic_config(ds_config)
+    except ElasticityError:
+        return []
+    return sorted(valid_gpus)
+
+
 def ensure_immutable_elastic_config(runtime_elastic_config_dict):
     """Guard that scheduler-time and runtime elastic configs agree
     (parity with elasticity/elasticity.py:207)."""

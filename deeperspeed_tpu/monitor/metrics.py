@@ -37,12 +37,20 @@ __all__ = [
     "MetricsServer",
     "export_to_tensorboard",
     "DEFAULT_LATENCY_BUCKETS",
+    "DEFAULT_SAVE_BUCKETS",
 ]
 
 # seconds; spans sub-ms decode steps to multi-second TTFT tails
 DEFAULT_LATENCY_BUCKETS = (
     0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
     1.0, 2.5, 5.0, 10.0, 30.0,
+)
+
+# seconds; checkpoint write+commit wall time — tiny CPU-test saves up to
+# multi-minute full-model writes on a slow disk
+DEFAULT_SAVE_BUCKETS = (
+    0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+    10.0, 30.0, 60.0, 120.0,
 )
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"

@@ -1,0 +1,201 @@
+"""Deterministic fault injection for resilience drills and tests.
+
+A ``FaultPlan`` names WHERE to hurt the process; the ``FaultInjector``
+holds the counters that decide WHEN. Faults come from the config block
+(``"resilience": {"faults": {...}}``) and/or the ``DS_TPU_FAULTS`` env
+var (JSON object, or ``k=v,k=v`` shorthand; env wins key-by-key) so a
+drill script can arm a child trainer without touching its config.
+
+Supported faults:
+
+  * ``raise_at_step: N``      — raise ``InjectedFault`` at optimizer
+    step N's boundary (generic crash).
+  * ``sigkill_at_step: N``    — SIGKILL the process at step N's
+    boundary (crash that skips every handler/atexit path).
+  * ``sigkill_mid_save: K``   — SIGKILL while the K-th checkpoint file
+    of the process's lifetime is being persisted, BEFORE the commit
+    rename: the canonical "died mid-save" drill. The committed/latest
+    state must be unaffected.
+  * ``corrupt_after_save: "truncate" | "bitflip"`` — after a commit,
+    damage one payload file in the published tag (simulated disk/bus
+    corruption); the manifest check at load must catch it.
+  * ``flag_file: path``       — one-shot latch: faults only fire while
+    ``path`` does not exist, and the injector creates it just before
+    firing. Lets a supervisor restart the SAME command line and have
+    the second run proceed cleanly.
+
+Everything is deterministic — counters, not probabilities — so drills
+are reproducible bit-for-bit.
+"""
+
+import dataclasses
+import json
+import os
+import signal
+from typing import Optional
+
+from ..utils.logging import logger
+
+FAULTS_ENV_VAR = "DS_TPU_FAULTS"
+
+_CORRUPT_MODES = ("truncate", "bitflip")
+
+
+class InjectedFault(RuntimeError):
+    """Raised by ``raise_at_step`` — a reproducible generic crash."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    raise_at_step: Optional[int] = None
+    sigkill_at_step: Optional[int] = None
+    sigkill_mid_save: Optional[int] = None
+    corrupt_after_save: Optional[str] = None
+    flag_file: Optional[str] = None
+
+    def __post_init__(self):
+        for key in ("raise_at_step", "sigkill_at_step", "sigkill_mid_save"):
+            v = getattr(self, key)
+            if v is not None and int(v) < 1:
+                raise ValueError(f"{key} must be >= 1, got {v}")
+        if (self.corrupt_after_save is not None
+                and self.corrupt_after_save not in _CORRUPT_MODES):
+            raise ValueError(
+                f"corrupt_after_save must be one of {_CORRUPT_MODES}, got "
+                f"{self.corrupt_after_save!r}")
+
+    @property
+    def any_armed(self) -> bool:
+        return any(getattr(self, f.name) is not None
+                   for f in dataclasses.fields(self)
+                   if f.name != "flag_file")
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "FaultPlan":
+        d = dict(d or {})
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(f"unknown fault keys {sorted(unknown)}; "
+                             f"valid keys: {sorted(known)}")
+        return cls(**d)
+
+
+def _parse_env_spec(spec: str) -> dict:
+    spec = spec.strip()
+    if not spec:
+        return {}
+    if spec.startswith("{"):
+        return json.loads(spec)
+    out = {}
+    for part in spec.split(","):
+        k, _, v = part.partition("=")
+        k = k.strip()
+        v = v.strip()
+        out[k] = int(v) if v.lstrip("-").isdigit() else v
+    return out
+
+
+def plan_from_config_and_env(config_faults: Optional[dict]) -> FaultPlan:
+    merged = dict(config_faults or {})
+    env = os.environ.get(FAULTS_ENV_VAR, "")
+    if env:
+        merged.update(_parse_env_spec(env))
+    return FaultPlan.from_dict(merged)
+
+
+def corrupt_file(path: str, mode: str = "truncate") -> None:
+    """Damage one on-disk file in place (test/drill utility)."""
+    if mode == "truncate":
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            f.truncate(max(size // 2, 0))
+    elif mode == "bitflip":
+        with open(path, "r+b") as f:
+            f.seek(max(os.path.getsize(path) // 2 - 1, 0))
+            byte = f.read(1) or b"\0"
+            f.seek(-len(byte), os.SEEK_CUR)
+            f.write(bytes([byte[0] ^ 0x40]))
+    else:
+        raise ValueError(f"unknown corruption mode {mode!r}")
+
+
+def _sigkill() -> None:  # pragma: no cover - kills the test process
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
+class FaultInjector:
+    """Counters + trigger points for one process. All hooks are no-ops
+    when the plan is empty, so production runs pay one attribute read."""
+
+    def __init__(self, plan: FaultPlan):
+        self.plan = plan
+        self._files_written = 0
+        self.armed = plan.any_armed
+        if self.armed:
+            logger.warning("fault injection ARMED: %s", plan)
+
+    # ---- one-shot latch ------------------------------------------- #
+
+    def _latched_out(self) -> bool:
+        """True when the one-shot flag file says faults already fired."""
+        return (self.plan.flag_file is not None
+                and os.path.exists(self.plan.flag_file))
+
+    def _latch(self) -> None:
+        if self.plan.flag_file is not None:
+            parent = os.path.dirname(self.plan.flag_file)
+            if parent:
+                os.makedirs(parent, exist_ok=True)
+            with open(self.plan.flag_file, "w") as f:
+                f.write("fired\n")
+                f.flush()
+                os.fsync(f.fileno())
+
+    # ---- trigger points -------------------------------------------- #
+
+    def on_step(self, global_step: int) -> None:
+        """Step-boundary faults (called after each optimizer step)."""
+        if not self.armed or self._latched_out():
+            return
+        if (self.plan.sigkill_at_step is not None
+                and global_step == self.plan.sigkill_at_step):
+            logger.warning("fault: SIGKILL at step %d", global_step)
+            self._latch()
+            _sigkill()
+        if (self.plan.raise_at_step is not None
+                and global_step == self.plan.raise_at_step):
+            self._latch()
+            raise InjectedFault(f"injected fault at step {global_step}")
+
+    def on_save_file_written(self, path: str) -> None:
+        """Called after each checkpoint payload file is written (still in
+        the staging dir, before the commit rename)."""
+        if not self.armed:
+            return
+        self._files_written += 1
+        if (self.plan.sigkill_mid_save is not None
+                and self._files_written >= self.plan.sigkill_mid_save
+                and not self._latched_out()):
+            logger.warning("fault: SIGKILL mid-save after writing %s", path)
+            self._latch()
+            _sigkill()
+
+    def after_commit(self, ckpt_dir: str) -> None:
+        """Called once per committed tag; corrupts one payload file when
+        the plan asks for it (the NEXT load must detect and fall back)."""
+        if (not self.armed or self.plan.corrupt_after_save is None
+                or self._latched_out()):
+            return
+        from .manifest import MANIFEST_FILE, COMMITTED_MARKER
+
+        for name in sorted(os.listdir(ckpt_dir)):
+            full = os.path.join(ckpt_dir, name)
+            if name in (MANIFEST_FILE, COMMITTED_MARKER):
+                continue
+            if os.path.isfile(full) and os.path.getsize(full) > 0:
+                self._latch()
+                corrupt_file(full, self.plan.corrupt_after_save)
+                logger.warning("fault: %s-corrupted %s",
+                               self.plan.corrupt_after_save, full)
+                return

@@ -1,0 +1,231 @@
+"""Auto-resume supervisor: restart crashed/preempted training runs.
+
+::
+
+    python -m deeperspeed_tpu.resilience.supervisor \
+        --checkpoint-dir /ckpts/run7 --max-restarts 20 \
+        -- python train.py --deepspeed_config ds.json
+
+The supervisor owns the restart policy so the trainer stays a plain
+script:
+
+  * exit 0                          -> done, exit 0.
+  * the preemption sentinel (86 by  -> restart immediately; preemptions
+    default, see config.py)            are routine on TPU pools and do
+                                       NOT count against the crash cap
+                                       or grow the backoff.
+  * anything else (crash, SIGKILL,  -> restart after exponential
+    OOM, infra flake)                  backoff (base * factor^n, capped)
+                                       until ``--max-restarts`` crashes.
+
+Before each restart the supervisor discovers the newest VALID tag in
+``--checkpoint-dir`` (manifest-verified; torn tags from the fatal
+instant are skipped) and exports it as ``DS_TPU_RESUME_TAG`` /
+``DS_TPU_RESUME_DIR`` — a trainer can simply call
+``engine.load_checkpoint(os.environ["DS_TPU_RESUME_DIR"])`` at start,
+and the latest-pointer fallback logic does the rest. ``DS_TPU_RESTART_
+COUNT`` counts total restarts.
+
+Elastic resume: with ``--elastic-config ds.json`` the supervisor reads
+the config's ``elasticity`` block and exports the valid accelerator
+counts as ``DS_TPU_ELASTIC_WORLD_SIZES`` — a restart may land on a
+different chip count (the pool shrank or grew); elasticity picks the
+batch geometry for whatever is available, and the orbax sharded loader
+re-shards the checkpoint onto the new mesh.
+
+The run loop is dependency-injectable (``run_fn``/``sleep_fn``) so the
+backoff policy is unit-testable without subprocesses.
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+from dataclasses import dataclass
+from typing import Callable, List, Optional, Sequence
+
+from ..utils.logging import logger
+from .config import PREEMPTION_EXIT_CODE_DEFAULT
+from .manifest import find_latest_valid_tag, tag_step
+
+RESUME_TAG_ENV = "DS_TPU_RESUME_TAG"
+RESUME_DIR_ENV = "DS_TPU_RESUME_DIR"
+RESTART_COUNT_ENV = "DS_TPU_RESTART_COUNT"
+ELASTIC_WORLD_SIZES_ENV = "DS_TPU_ELASTIC_WORLD_SIZES"
+
+
+def compute_backoff(failures: int, base: float, factor: float,
+                    cap: float) -> float:
+    """Delay before restart number ``failures`` (1-based): base *
+    factor^(failures-1), capped. Pure so the policy is testable."""
+    if failures <= 0:
+        return 0.0
+    return min(cap, base * factor ** (failures - 1))
+
+
+@dataclass
+class SupervisorPolicy:
+    max_restarts: int = 10  # crash restarts; preemptions are free
+    backoff_base: float = 1.0
+    backoff_factor: float = 2.0
+    backoff_max: float = 60.0
+    preempt_exit_code: int = PREEMPTION_EXIT_CODE_DEFAULT
+    checkpoint_dir: Optional[str] = None
+    elastic_config: Optional[str] = None
+    verify_checksums: bool = True
+
+
+class Supervisor:
+    def __init__(self, cmd: Sequence[str], policy: SupervisorPolicy,
+                 run_fn: Optional[Callable[[List[str], dict], int]] = None,
+                 sleep_fn: Callable[[float], None] = time.sleep):
+        if not cmd:
+            raise ValueError("supervisor needs a command to run")
+        self.cmd = list(cmd)
+        self.policy = policy
+        self._run_fn = run_fn or self._run_subprocess
+        self._sleep_fn = sleep_fn
+        self.restarts = 0  # total child launches minus one
+        self.crashes = 0  # non-preemption failures (drives backoff/cap)
+        self.history: List[int] = []  # child return codes, in order
+
+    @staticmethod
+    def _run_subprocess(cmd: List[str], env: dict) -> int:
+        return subprocess.call(cmd, env=env)
+
+    # ------------------------------------------------------------------ #
+
+    def _child_env(self) -> dict:
+        env = dict(os.environ)
+        env[RESTART_COUNT_ENV] = str(self.restarts)
+        pol = self.policy
+        if pol.checkpoint_dir:
+            tag = find_latest_valid_tag(
+                pol.checkpoint_dir, verify_checksums=pol.verify_checksums)
+            if tag is not None:
+                env[RESUME_TAG_ENV] = tag
+                env[RESUME_DIR_ENV] = pol.checkpoint_dir
+                step = tag_step(tag)
+                logger.info(
+                    "supervisor: newest valid checkpoint is %r%s",
+                    tag, f" (step {step})" if step is not None else "")
+            else:
+                env.pop(RESUME_TAG_ENV, None)
+                env.pop(RESUME_DIR_ENV, None)
+                if self.restarts:
+                    logger.warning(
+                        "supervisor: no valid checkpoint in %s; the "
+                        "restart begins from scratch", pol.checkpoint_dir)
+        if pol.elastic_config:
+            sizes = self._elastic_world_sizes(pol.elastic_config)
+            if sizes:
+                env[ELASTIC_WORLD_SIZES_ENV] = ",".join(map(str, sizes))
+                logger.info("supervisor: elastic world sizes %s", sizes)
+        return env
+
+    @staticmethod
+    def _elastic_world_sizes(config_path: str) -> List[int]:
+        try:
+            with open(config_path) as f:
+                cfg = json.load(f)
+            from ..elasticity import elastic_world_sizes
+
+            return elastic_world_sizes(cfg)
+        except Exception as e:  # noqa: BLE001 - advisory only
+            logger.warning("supervisor: could not compute elastic world "
+                           "sizes from %s: %s", config_path, e)
+            return []
+
+    # ------------------------------------------------------------------ #
+
+    def run(self) -> int:
+        pol = self.policy
+        while True:
+            rc = self._run_fn(self.cmd, self._child_env())
+            self.history.append(rc)
+            if rc == 0:
+                logger.info("supervisor: run finished cleanly after %d "
+                            "restart(s)", self.restarts)
+                return 0
+            preempted = rc == pol.preempt_exit_code
+            if preempted:
+                delay = 0.0
+                logger.warning(
+                    "supervisor: child preempted (exit %d); restarting "
+                    "immediately", rc)
+            else:
+                self.crashes += 1
+                if self.crashes > pol.max_restarts:
+                    logger.error(
+                        "supervisor: giving up after %d crash(es) "
+                        "(max_restarts=%d); last exit code %d",
+                        self.crashes, pol.max_restarts, rc)
+                    return rc
+                delay = compute_backoff(
+                    self.crashes, pol.backoff_base, pol.backoff_factor,
+                    pol.backoff_max)
+                logger.warning(
+                    "supervisor: child crashed (exit %d, crash %d/%d); "
+                    "restarting in %.1fs", rc, self.crashes,
+                    pol.max_restarts, delay)
+            if delay > 0:
+                self._sleep_fn(delay)
+            self.restarts += 1
+
+
+# --------------------------------------------------------------------- #
+# CLI
+# --------------------------------------------------------------------- #
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m deeperspeed_tpu.resilience.supervisor",
+        description="Restart a training command on crash/preemption, "
+                    "resuming from the newest valid checkpoint.")
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="where the trainer saves; scanned for the newest "
+                        "valid tag before each restart")
+    p.add_argument("--max-restarts", type=int, default=10,
+                   help="crash-restart cap (preemptions do not count)")
+    p.add_argument("--backoff-base", type=float, default=1.0)
+    p.add_argument("--backoff-factor", type=float, default=2.0)
+    p.add_argument("--backoff-max", type=float, default=60.0)
+    p.add_argument("--preempt-exit-code", type=int,
+                   default=PREEMPTION_EXIT_CODE_DEFAULT,
+                   help="sentinel exit code the preemption guard uses")
+    p.add_argument("--elastic-config", default=None, metavar="DS_JSON",
+                   help="master config with an elasticity block; exports "
+                        "the valid world sizes to the child")
+    p.add_argument("--no-verify", action="store_true",
+                   help="skip manifest checksum verification during "
+                        "checkpoint discovery (size/presence only)")
+    p.add_argument("cmd", nargs=argparse.REMAINDER,
+                   help="-- followed by the training command")
+    return p
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    cmd = list(args.cmd)
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        build_parser().error("no training command given (put it after --)")
+    policy = SupervisorPolicy(
+        max_restarts=args.max_restarts,
+        backoff_base=args.backoff_base,
+        backoff_factor=args.backoff_factor,
+        backoff_max=args.backoff_max,
+        preempt_exit_code=args.preempt_exit_code,
+        checkpoint_dir=args.checkpoint_dir,
+        elastic_config=args.elastic_config,
+        verify_checksums=not args.no_verify,
+    )
+    return Supervisor(cmd, policy).run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

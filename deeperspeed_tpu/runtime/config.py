@@ -327,6 +327,34 @@ class TrainingConfig:
             except ValueError as e:
                 raise ConfigError(f'invalid "monitor" block: {e}') from e
 
+        # ---- resilience (async checkpointing / preemption / resume) ----
+        # A "resilience" block turns on the fault-tolerance subsystem
+        # (resilience/ package): async two-phase-commit saves, manifest
+        # verification at load, the preemption guard, fault injection.
+        # Validated eagerly like "serving"/"monitor".
+        self.resilience_params = pd.get(c.RESILIENCE, None)
+        if self.resilience_params is not None and not isinstance(
+                self.resilience_params, dict):
+            raise ConfigError(
+                '"resilience" must be a dict of ResilienceConfig '
+                'overrides (or {"enabled": false})'
+            )
+        explicit_resilience = (self.resilience_params or {}).get(
+            c.RESILIENCE_ENABLED)
+        self.resilience_enabled = (
+            explicit_resilience if explicit_resilience is not None
+            else self.resilience_params is not None
+        )
+        self._resilience_config = None
+        if self.resilience_enabled:
+            from ..resilience.config import ResilienceConfig
+
+            try:
+                self._resilience_config = ResilienceConfig.from_dict(
+                    dict(self.resilience_params, enabled=True))
+            except ValueError as e:
+                raise ConfigError(f'invalid "resilience" block: {e}') from e
+
         # ---- fused Pallas kernels ----
         # A "kernels" block selects the fused elementwise/optimizer/
         # super-tile attention kernels (ops/kernel_config.py): mode
@@ -369,6 +397,11 @@ class TrainingConfig:
         """The "monitor" block as a MonitorConfig (None when absent or
         disabled); validated at parse time like "serving"."""
         return self._monitor_config
+
+    def resilience_config(self):
+        """The "resilience" block as a ResilienceConfig (None when
+        absent or disabled); validated at parse time like "serving"."""
+        return self._resilience_config
 
     def get_sparse_attention(self, num_heads: int):
         """Build the configured SparsityConfig (reference runtime/config.py:213

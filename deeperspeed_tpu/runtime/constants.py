@@ -244,3 +244,13 @@ MONITOR_ENABLED_DEFAULT = False
 KERNELS = "kernels"
 KERNELS_MODE = "mode"
 KERNELS_MODE_DEFAULT = "off"
+
+#############################################
+# Resilience (resilience/ package): async two-phase-commit
+# checkpointing, preemption guard, fault injection, auto-resume.
+# Keys are validated by resilience.config.ResilienceConfig.from_dict;
+# block presence enables unless {"enabled": false}.
+#############################################
+RESILIENCE = "resilience"
+RESILIENCE_ENABLED = "enabled"
+RESILIENCE_ENABLED_DEFAULT = False

@@ -47,6 +47,7 @@ from ..ops.adam import DeepSpeedCPUAdam, FusedAdam
 from ..ops.lamb import FusedLamb
 from ..ops.sgd import SGD
 from ..monitor import get_monitor, init_monitor, trace_span
+from ..resilience.manifest import resolve_load_tag
 from ..parallel.topology import DATA_AXIS, build_mesh, single_device_mesh
 from ..utils.logging import log_dist, logger
 from ..utils.timer import SynchronizedWallClockTimer, ThroughputTimer
@@ -203,6 +204,17 @@ class Engine(ConfigAccessorsMixin):
             from ..ops.kernel_config import configure as _configure_kernels
 
             _configure_kernels(**config.kernels_params)
+
+        # resilience (resilience/ package): a "resilience" config block
+        # installs the process-global manager (async two-phase-commit
+        # saves, preemption guard, fault injection); absent one, an
+        # already-installed manager is adopted like the monitor above
+        from ..resilience import get_resilience_manager, init_resilience
+
+        if config.resilience_config() is not None:
+            self._resilience = init_resilience(config.resilience_config())
+        else:
+            self._resilience = get_resilience_manager()
 
         # the fused train step legitimately traces twice: the initial
         # state is an uncommitted single-device array, the step's output
@@ -990,6 +1002,13 @@ class Engine(ConfigAccessorsMixin):
             self.timers(STEP_MICRO_TIMER).stop()
         self.micro_steps += 1
 
+    def _end_of_step_resilience(self):
+        """Step-boundary resilience hook: fault injection, preemption
+        (urgent checkpoint + sentinel exit), interval autosaves. Shared
+        by the fused train_batch path and the imperative step() path."""
+        if self._resilience is not None:
+            self._resilience.on_step_boundary(self)
+
     def _after_optimizer_step(self, metrics):
         """Bookkeeping after the jitted update. The blocking scalar fetch of
         the overflow flag only happens for a DYNAMIC loss scaler (fp16), where
@@ -1037,6 +1056,7 @@ class Engine(ConfigAccessorsMixin):
             if self.lr_scheduler is not None:
                 self.lr_scheduler.step()
                 self._lr_override = None
+        self._end_of_step_resilience()
 
     def train_batch(self, batch=None, data_iter=None):
         """Fused one-step API (the TPU-native hot path). Accepts either a full
@@ -1256,6 +1276,46 @@ class Engine(ConfigAccessorsMixin):
         reps = jax.tree.map(lambda _: NamedSharding(self.mesh, P()), tree)
         return jax.jit(lambda t: t, out_shardings=reps)(tree)
 
+    def _host_checkpoint_payload(self, state=None, client_state=None):
+        """Blocking device->host snapshot of everything a legacy-layout
+        checkpoint stores, keyed by destination filename. The resilience
+        manager takes this at the step boundary and hands it to the
+        background writer (the arrays are host numpy, so training can
+        mutate device state while the write proceeds); the sync save
+        path writes the same payload inline."""
+        if state is None:
+            state = self.state
+        model_states = {
+            "module": to_host(state.params),
+            "global_steps": self.global_steps,
+            "global_samples": self.global_samples,
+            "skipped_steps": self.skipped_steps,
+            "micro_steps": self.micro_steps,
+            "dp_world_size": self.data_parallel_size,
+            "mp_world_size": int(self.mesh.shape.get("model", 1)),
+            # bounds the per-rank offload-file scan on load (stale files
+            # from an older, larger save into the same tag are ignored)
+            "process_count": jax.process_count(),
+            "lr_scheduler": (
+                self.lr_scheduler.state_dict() if self.lr_scheduler else {}
+            ),
+            "client_state": client_state or {},
+        }
+        optim_states = {
+            "master": to_host(state.master) if state.master is not None else {},
+            "opt_state": to_host(state.opt_state),
+            "scaler": to_host(state.scaler._asdict()),
+            "step": int(jax.device_get(state.step)),
+            "zero_stage": self.zero_stage,
+        }
+        if self._offload is not None:
+            # host/NVMe state is the source of truth under offload
+            optim_states["offload"] = self._offload.state_dict()
+        return {
+            model_state_filename(): model_states,
+            optim_state_filename(): optim_states,
+        }
+
     def save_checkpoint(self, save_dir, tag=None, client_state=None, save_latest=True):
         self._tb_write_pending()
         if tag is None:
@@ -1265,6 +1325,12 @@ class Engine(ConfigAccessorsMixin):
             validate_tag_across_processes(
                 tag, self._config.checkpoint_tag_validation_fail
             )
+        if self._resilience is not None:
+            self._resilience.note_save_dir(save_dir)
+            if self._resilience.handles_save():
+                return self._resilience.save_checkpoint(
+                    self, save_dir, tag, client_state,
+                    save_latest=save_latest)
         ck = CheckpointEngine(save_dir, tag)
         if self._config.checkpoint_sharded_io:
             if self._offload is None:
@@ -1297,34 +1363,9 @@ class Engine(ConfigAccessorsMixin):
                 )
             if jax.process_index() != 0:
                 return True
-        model_states = {
-            "module": to_host(state.params),
-            "global_steps": self.global_steps,
-            "global_samples": self.global_samples,
-            "skipped_steps": self.skipped_steps,
-            "micro_steps": self.micro_steps,
-            "dp_world_size": self.data_parallel_size,
-            "mp_world_size": int(self.mesh.shape.get("model", 1)),
-            # bounds the per-rank offload-file scan on load (stale files
-            # from an older, larger save into the same tag are ignored)
-            "process_count": jax.process_count(),
-            "lr_scheduler": (
-                self.lr_scheduler.state_dict() if self.lr_scheduler else {}
-            ),
-            "client_state": client_state or {},
-        }
-        ck.save(model_state_filename(), model_states)
-        optim_states = {
-            "master": to_host(state.master) if state.master is not None else {},
-            "opt_state": to_host(state.opt_state),
-            "scaler": to_host(state.scaler._asdict()),
-            "step": int(jax.device_get(state.step)),
-            "zero_stage": self.zero_stage,
-        }
-        if self._offload is not None:
-            # host/NVMe state is the source of truth under offload
-            optim_states["offload"] = self._offload.state_dict()
-        ck.save(optim_state_filename(), optim_states)
+        for fname, tree in self._host_checkpoint_payload(
+                state=state, client_state=client_state).items():
+            ck.save(fname, tree)
         if save_latest and jax.process_index() == 0:
             write_latest(save_dir, tag)
         # drop the recovery tool next to the shards (reference
@@ -1514,12 +1555,27 @@ class Engine(ConfigAccessorsMixin):
             if tag is None:
                 logger.warning("no 'latest' file in %s; nothing loaded", load_dir)
                 return None, {}
+        # never load a torn/corrupt tag: committed tags verify against
+        # their manifest, and an unloadable requested tag falls back to
+        # the newest older valid one (a crash mid-save costs at most one
+        # checkpoint interval, never the run)
+        verify = (self._resilience.cfg.verify_on_load
+                  if self._resilience is not None else True)
+        tag, fell_back = resolve_load_tag(load_dir, str(tag),
+                                          verify_checksums=verify)
+        if tag is None:
+            return None, {}
+        if fell_back and self._resilience is not None:
+            self._resilience.note_fallback()
         ck = CheckpointEngine(load_dir, str(tag))
         if os.path.isdir(ck.path(SHARDED_STATE_DIR)):
-            return self._load_checkpoint_sharded(
+            loaded = self._load_checkpoint_sharded(
                 ck, load_module_only, load_optimizer_states,
                 load_lr_scheduler_states,
             )
+            if loaded[0] is not None and self._resilience is not None:
+                self._resilience.note_resumed(tag)
+            return loaded
         if not ck.exists(model_state_filename()):
             logger.warning("checkpoint %s not found", ck.ckpt_dir)
             return None, {}
@@ -1611,6 +1667,8 @@ class Engine(ConfigAccessorsMixin):
         ):
             self.lr_scheduler.load_state_dict(model_states["lr_scheduler"])
         log_dist(f"loaded checkpoint {ck.ckpt_dir}", ranks=[0])
+        if self._resilience is not None:
+            self._resilience.note_resumed(tag)
         return ck.ckpt_dir, model_states.get("client_state", {})
 
 

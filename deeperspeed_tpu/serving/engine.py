@@ -174,6 +174,14 @@ class _ServingBase:
         self._rid_counter = itertools.count()
         self._requests: Dict[str, Request] = {}
         self._step_i = 0
+        # preemption drain: while set, step() admits nothing new and only
+        # finishes the requests already holding slots
+        self._draining = False
+        from ..resilience import get_resilience_manager
+
+        mgr = get_resilience_manager()
+        if mgr is not None:
+            mgr.attach_serving(self)
 
     # -- queue surface ------------------------------------------------ #
 
@@ -217,8 +225,9 @@ class _ServingBase:
             now = self.clock()
             for req in self.sched.expire_timeouts(now):
                 self.metrics.record_finish(req, now)
-            while (adm := self.sched.pop_admissible()) is not None:
-                self._admit_one(*adm)
+            if not self._draining:
+                while (adm := self.sched.pop_admissible()) is not None:
+                    self._admit_one(*adm)
             for _ in self.sched.ensure_decode_capacity():
                 self.metrics.record_preemption()
             trace_counter("serving/load", {
@@ -241,6 +250,20 @@ class _ServingBase:
             if max_steps is not None and steps >= max_steps:
                 break
         return {r.rid: r.output for r in self.sched.finished}
+
+    def drain(self, max_steps: Optional[int] = None) -> List[str]:
+        """Preemption drain: stop admitting, run decode until every
+        in-flight (slot-holding) request finishes, and return the rids
+        left queued — the caller (the resilience preemption protocol, or
+        an external LB) is expected to re-submit those elsewhere."""
+        self._draining = True
+        steps = 0
+        while self.sched.num_active:
+            self.step()
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                break
+        return [r.rid for r in self.sched.queue]
 
     # -- helpers ------------------------------------------------------ #
 

@@ -1,0 +1,518 @@
+"""resilience/ subsystem: manifest two-phase commit, async writer,
+fault injection, corruption fallback, preemption protocol, supervisor
+backoff, and the end-to-end SIGKILL-mid-save drill (subprocess)."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deeperspeed_tpu as deepspeed
+from deeperspeed_tpu.resilience import (
+    AsyncCheckpointWriter,
+    CheckpointWriteError,
+    COMMITTED_MARKER,
+    FaultInjector,
+    FaultPlan,
+    InjectedFault,
+    MANIFEST_FILE,
+    ResilienceConfig,
+    Supervisor,
+    SupervisorPolicy,
+    commit_checkpoint,
+    compute_backoff,
+    corrupt_file,
+    find_latest_valid_tag,
+    is_committed,
+    resolve_load_tag,
+    shutdown_resilience,
+    tag_status,
+    verify_manifest,
+    write_manifest,
+)
+from deeperspeed_tpu.resilience.faults import _parse_env_spec
+from deeperspeed_tpu.resilience.manifest import staging_dir_for
+
+
+@pytest.fixture(autouse=True)
+def _reset_global_manager():
+    """Engines with a resilience block install a process-global manager
+    (signal handlers + writer thread); tear it down between tests."""
+    yield
+    shutdown_resilience()
+
+
+# --------------------------------------------------------------------- #
+# manifest + two-phase commit
+# --------------------------------------------------------------------- #
+
+
+def _write(path, data=b"payload"):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(data)
+
+
+def test_manifest_round_trip_and_corruption(tmp_path):
+    d = str(tmp_path / "tag")
+    _write(os.path.join(d, "a.msgpack"), b"aaaa")
+    _write(os.path.join(d, "sub/b.msgpack"), b"bbbb")
+    write_manifest(d)
+    ok, problems = verify_manifest(d)
+    assert ok and problems == []
+    corrupt_file(os.path.join(d, "a.msgpack"), "bitflip")
+    ok, problems = verify_manifest(d)
+    assert not ok and any("sha256" in p for p in problems)
+    # size-only check misses a same-size bitflip; truncation it catches
+    ok, _ = verify_manifest(d, check_checksums=False)
+    assert ok
+    corrupt_file(os.path.join(d, "sub/b.msgpack"), "truncate")
+    ok, problems = verify_manifest(d, check_checksums=False)
+    assert not ok and any("size" in p for p in problems)
+
+
+def test_commit_publishes_atomically(tmp_path):
+    save_dir = str(tmp_path)
+    staging = staging_dir_for(save_dir, "global_step5")
+    _write(os.path.join(staging, "model.msgpack"))
+    write_manifest(staging)
+    assert tag_status(staging) == "staging"
+    final = os.path.join(save_dir, "global_step5")
+    commit_checkpoint(staging, final)
+    assert not os.path.exists(staging)
+    assert is_committed(final)
+    assert tag_status(final) == "committed"
+    # a manifest without a marker is the died-between-manifest-and-commit
+    # state and must never be treated as loadable
+    os.unlink(os.path.join(final, COMMITTED_MARKER))
+    assert tag_status(final) == "partial"
+
+
+def test_tag_status_legacy_and_corrupt(tmp_path):
+    legacy = str(tmp_path / "global_step1")
+    _write(os.path.join(legacy, "mp_rank_00_model_states.msgpack"))
+    assert tag_status(legacy) == "legacy"
+    committed = str(tmp_path / "global_step2")
+    _write(os.path.join(committed, "mp_rank_00_model_states.msgpack"))
+    write_manifest(committed)
+    with open(os.path.join(committed, COMMITTED_MARKER), "w") as f:
+        f.write("ok\n")
+    assert tag_status(committed) == "committed"
+    corrupt_file(os.path.join(committed, "mp_rank_00_model_states.msgpack"),
+                 "bitflip")
+    assert tag_status(committed) == "corrupt"
+
+
+def test_resolve_load_tag_fallback(tmp_path):
+    for step, good in ((1, True), (2, True), (3, False)):
+        d = str(tmp_path / f"global_step{step}")
+        _write(os.path.join(d, "mp_rank_00_model_states.msgpack"),
+               b"x" * 64)
+        write_manifest(d)
+        with open(os.path.join(d, COMMITTED_MARKER), "w") as f:
+            f.write("ok\n")
+        if not good:
+            corrupt_file(
+                os.path.join(d, "mp_rank_00_model_states.msgpack"), "bitflip")
+    assert resolve_load_tag(str(tmp_path), "global_step2") == (
+        "global_step2", False)
+    # corrupt requested tag falls back to the newest older valid one
+    assert resolve_load_tag(str(tmp_path), "global_step3") == (
+        "global_step2", True)
+    assert find_latest_valid_tag(str(tmp_path)) == "global_step2"
+    # no request (no latest pointer) never invents a tag
+    assert resolve_load_tag(str(tmp_path), None) == (None, False)
+    # nothing loadable at all
+    assert resolve_load_tag(str(tmp_path / "empty"), "global_step9") == (
+        None, False)
+
+
+# --------------------------------------------------------------------- #
+# async writer
+# --------------------------------------------------------------------- #
+
+
+def test_writer_runs_jobs_in_order_and_waits():
+    w = AsyncCheckpointWriter(max_pending=2)
+    done = []
+    for i in range(5):
+        w.submit(lambda i=i: done.append(i))
+    w.wait()
+    assert done == [0, 1, 2, 3, 4]
+    w.close()
+
+
+def test_writer_propagates_errors_to_training_thread():
+    w = AsyncCheckpointWriter(max_pending=2)
+
+    def boom():
+        raise OSError("disk gone")
+
+    w.submit(boom)
+    with pytest.raises(CheckpointWriteError, match="disk gone"):
+        w.wait()
+    # the error is consumed; the writer keeps working afterwards
+    out = []
+    w.submit(lambda: out.append(1))
+    w.wait()
+    assert out == [1]
+    w.close()
+    with pytest.raises(CheckpointWriteError):
+        w.submit(lambda: None)
+
+
+def test_writer_bounded_queue_backpressure():
+    import threading
+
+    gate = threading.Event()
+    w = AsyncCheckpointWriter(max_pending=1)
+    w.submit(gate.wait)  # occupies the worker
+    w.submit(lambda: None)  # fills the one queue slot
+    t0 = time.monotonic()
+    t = threading.Thread(target=lambda: w.submit(lambda: None))
+    t.start()
+    t.join(timeout=0.2)
+    assert t.is_alive(), "third submit should block on the bounded queue"
+    gate.set()
+    t.join(timeout=10)
+    assert not t.is_alive()
+    w.wait()
+    w.close()
+    assert time.monotonic() - t0 < 30
+
+
+# --------------------------------------------------------------------- #
+# fault injection
+# --------------------------------------------------------------------- #
+
+
+def test_fault_env_spec_parsing():
+    assert _parse_env_spec('{"sigkill_mid_save": 3}') == {
+        "sigkill_mid_save": 3}
+    assert _parse_env_spec("raise_at_step=2, corrupt_after_save=bitflip") == {
+        "raise_at_step": 2, "corrupt_after_save": "bitflip"}
+    assert _parse_env_spec("") == {}
+    with pytest.raises(ValueError):
+        FaultPlan.from_dict({"not_a_fault": 1})
+    with pytest.raises(ValueError):
+        FaultPlan.from_dict({"corrupt_after_save": "chew"})
+
+
+def test_fault_injector_raise_and_one_shot_latch(tmp_path):
+    flag = str(tmp_path / "fired.flag")
+    plan = FaultPlan(raise_at_step=3, flag_file=flag)
+    inj = FaultInjector(plan)
+    inj.on_step(2)  # not yet
+    with pytest.raises(InjectedFault):
+        inj.on_step(3)
+    assert os.path.exists(flag)
+    # a fresh injector (the restarted process) sees the latch and stays
+    # quiet — the supervisor can rerun the same command line
+    FaultInjector(plan).on_step(3)
+
+
+def test_fault_corrupts_committed_tag(tmp_path):
+    d = str(tmp_path / "global_step1")
+    _write(os.path.join(d, "mp_rank_00_model_states.msgpack"), b"y" * 128)
+    write_manifest(d)
+    with open(os.path.join(d, COMMITTED_MARKER), "w") as f:
+        f.write("ok\n")
+    inj = FaultInjector(FaultPlan(corrupt_after_save="truncate"))
+    inj.after_commit(d)
+    assert tag_status(d) == "corrupt"
+
+
+# --------------------------------------------------------------------- #
+# engine integration
+# --------------------------------------------------------------------- #
+
+
+def _loss_fn(p, b):
+    x, y = b
+    return jnp.mean((x @ p["w"] - y) ** 2)
+
+
+def _engine(resilience=None, seed=0):
+    cfg = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+    }
+    if resilience is not None:
+        cfg["resilience"] = resilience
+    params = {"w": jax.random.normal(jax.random.PRNGKey(seed), (4, 2)) * 0.1}
+    engine, _, _, _ = deepspeed.initialize(
+        model=_loss_fn, model_parameters=params, config_params=cfg)
+    return engine
+
+
+def _batch(seed=0):
+    rs = np.random.RandomState(seed)
+    return (jnp.asarray(rs.randn(8, 4).astype(np.float32)),
+            jnp.asarray(rs.randn(8, 2).astype(np.float32)))
+
+
+def test_resilience_save_commits_with_manifest(tmp_path):
+    engine = _engine(resilience={"async_save": True,
+                                 "preemption_guard": False})
+    engine.train_batch(batch=_batch())
+    engine.save_checkpoint(str(tmp_path))
+    engine._resilience.wait_for_pending_saves()
+    tag_dir = tmp_path / "global_step1"
+    assert is_committed(str(tag_dir))
+    ok, problems = verify_manifest(str(tag_dir))
+    assert ok, problems
+    assert not os.path.exists(str(tag_dir) + ".tmp")
+    # and the async checkpoint round-trips into a fresh engine
+    engine2 = _engine(seed=1)
+    path, _ = engine2.load_checkpoint(str(tmp_path))
+    assert path is not None
+    np.testing.assert_allclose(
+        np.asarray(engine2.state.params["w"], np.float32),
+        np.asarray(engine.state.params["w"], np.float32),
+        rtol=1e-6, atol=0)
+
+
+def test_corrupt_latest_falls_back_to_older_tag(tmp_path):
+    engine = _engine(resilience={"async_save": False,
+                                 "preemption_guard": False})
+    engine.train_batch(batch=_batch(0))
+    engine.save_checkpoint(str(tmp_path))
+    w_step1 = np.asarray(engine.state.params["w"], np.float32).copy()
+    engine.train_batch(batch=_batch(1))
+    engine.save_checkpoint(str(tmp_path))
+    corrupt_file(
+        str(tmp_path / "global_step2" / "mp_rank_00_model_states.msgpack"),
+        "bitflip")
+    fresh = _engine(seed=1)
+    path, _ = fresh.load_checkpoint(str(tmp_path))
+    assert path is not None and path.endswith("global_step1")
+    assert fresh.global_steps == 1
+    np.testing.assert_allclose(
+        np.asarray(fresh.state.params["w"], np.float32), w_step1,
+        rtol=1e-6, atol=0)
+
+
+def test_interval_autosave_and_keep_last(tmp_path):
+    engine = _engine(resilience={"save_dir": str(tmp_path),
+                                 "save_interval_steps": 1,
+                                 "keep_last": 2,
+                                 "async_save": False,
+                                 "preemption_guard": False})
+    for i in range(4):
+        engine.train_batch(batch=_batch(i))
+    tags = sorted(p.name for p in tmp_path.iterdir() if p.is_dir())
+    assert tags == ["global_step3", "global_step4"]
+    assert all(is_committed(str(tmp_path / t)) for t in tags)
+
+
+def test_preemption_exits_with_sentinel_after_urgent_save(tmp_path):
+    engine = _engine(resilience={"save_dir": str(tmp_path),
+                                 "async_save": True})
+    try:
+        engine.train_batch(batch=_batch(0))
+        signal.raise_signal(signal.SIGTERM)
+        with pytest.raises(SystemExit) as exc:
+            engine.train_batch(batch=_batch(1))
+        assert exc.value.code == 86
+        tag_dir = tmp_path / "global_step2"
+        assert is_committed(str(tag_dir))
+        ok, problems = verify_manifest(str(tag_dir))
+        assert ok, problems
+    finally:
+        shutdown_resilience()
+    # the restarted process resumes from the urgent checkpoint
+    fresh = _engine(seed=1)
+    path, _ = fresh.load_checkpoint(str(tmp_path))
+    assert path is not None and fresh.global_steps == 2
+
+
+# --------------------------------------------------------------------- #
+# supervisor
+# --------------------------------------------------------------------- #
+
+
+def test_compute_backoff():
+    assert compute_backoff(1, 1.0, 2.0, 60.0) == 1.0
+    assert compute_backoff(2, 1.0, 2.0, 60.0) == 2.0
+    assert compute_backoff(3, 1.0, 2.0, 60.0) == 4.0
+    assert compute_backoff(10, 1.0, 2.0, 60.0) == 60.0
+    assert compute_backoff(0, 1.0, 2.0, 60.0) == 0.0
+
+
+def test_supervisor_backoff_crash_vs_preemption():
+    rcs = iter([1, 1, 86, 0])
+    sleeps = []
+    sup = Supervisor(
+        ["trainer"],
+        SupervisorPolicy(max_restarts=5, backoff_base=1.0,
+                         backoff_factor=2.0, backoff_max=60.0),
+        run_fn=lambda cmd, env: next(rcs),
+        sleep_fn=sleeps.append)
+    assert sup.run() == 0
+    # crashes back off exponentially; the preemption restarts with none
+    assert sleeps == [1.0, 2.0]
+    assert sup.crashes == 2
+    assert sup.restarts == 3
+    assert sup.history == [1, 1, 86, 0]
+
+
+def test_supervisor_gives_up_at_crash_cap():
+    sleeps = []
+    sup = Supervisor(
+        ["trainer"],
+        SupervisorPolicy(max_restarts=2, backoff_base=0.5,
+                         backoff_factor=2.0, backoff_max=60.0),
+        run_fn=lambda cmd, env: 7,
+        sleep_fn=sleeps.append)
+    assert sup.run() == 7
+    assert sup.crashes == 3  # the cap counts RESTARTS, so 3 runs total
+    assert sleeps == [0.5, 1.0]
+
+
+def test_supervisor_exports_resume_env(tmp_path):
+    d = str(tmp_path / "global_step4")
+    _write(os.path.join(d, "mp_rank_00_model_states.msgpack"), b"z" * 32)
+    write_manifest(d)
+    with open(os.path.join(d, COMMITTED_MARKER), "w") as f:
+        f.write("ok\n")
+    seen = {}
+
+    def fake_run(cmd, env):
+        seen.update({k: env.get(k) for k in
+                     ("DS_TPU_RESUME_TAG", "DS_TPU_RESUME_DIR",
+                      "DS_TPU_RESTART_COUNT")})
+        return 0
+
+    sup = Supervisor(
+        ["trainer"],
+        SupervisorPolicy(checkpoint_dir=str(tmp_path)),
+        run_fn=fake_run)
+    assert sup.run() == 0
+    assert seen["DS_TPU_RESUME_TAG"] == "global_step4"
+    assert seen["DS_TPU_RESUME_DIR"] == str(tmp_path)
+    assert seen["DS_TPU_RESTART_COUNT"] == "0"
+
+
+# --------------------------------------------------------------------- #
+# end-to-end: SIGKILL mid-save, then bit-identical resume (subprocess)
+# --------------------------------------------------------------------- #
+
+_TRAINER = """\
+import sys
+import numpy as np
+import jax.numpy as jnp
+import deeperspeed_tpu as deepspeed
+from deeperspeed_tpu.resilience import shutdown_resilience
+
+ckpt_dir, steps = sys.argv[1], int(sys.argv[2])
+
+def loss_fn(p, b):
+    x, y = b
+    return jnp.mean((x @ p["w"] - y) ** 2)
+
+cfg = {
+    "train_batch_size": 8,
+    "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+    "resilience": {"save_dir": ckpt_dir, "save_interval_steps": 2,
+                   "async_save": True, "preemption_guard": False},
+}
+params = {"w": jnp.zeros((4, 2), jnp.float32)}  # deterministic init
+engine, _, _, _ = deepspeed.initialize(
+    model=loss_fn, model_parameters=params, config_params=cfg)
+path, _ = engine.load_checkpoint(ckpt_dir)
+start = engine.global_steps if path is not None else 0
+for i in range(start, steps):
+    rs = np.random.RandomState(i)  # batch keyed by global step
+    b = (jnp.asarray(rs.randn(8, 4).astype(np.float32)),
+         jnp.asarray(rs.randn(8, 2).astype(np.float32)))
+    loss = engine.train_batch(batch=b)
+    print(f"STEP {i} LOSS {float(loss):.17e}", flush=True)
+shutdown_resilience()
+"""
+
+
+def _run_trainer(script, ckpt_dir, steps, faults=None):
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("XLA_FLAGS", None)  # single CPU device: faster startup
+    if faults is not None:
+        env["DS_TPU_FAULTS"] = faults
+    else:
+        env.pop("DS_TPU_FAULTS", None)
+    return subprocess.run(
+        [sys.executable, script, ckpt_dir, str(steps)],
+        env=env, capture_output=True, text=True, timeout=300)
+
+
+def _losses(stdout):
+    out = {}
+    for line in stdout.splitlines():
+        if line.startswith("STEP "):
+            _, i, _, loss = line.split()
+            out[int(i)] = loss
+    return out
+
+
+def test_sigkill_mid_save_then_resume_bit_identical(tmp_path):
+    script = str(tmp_path / "trainer.py")
+    with open(script, "w") as f:
+        f.write(_TRAINER)
+    # reference: uninterrupted 6 steps in its own directory
+    ref = _run_trainer(script, str(tmp_path / "ref"), 6)
+    assert ref.returncode == 0, ref.stderr[-2000:]
+    ref_losses = _losses(ref.stdout)
+    assert sorted(ref_losses) == list(range(6))
+
+    # run 1: autosave every 2 steps writes 2 files per tag; the fault
+    # SIGKILLs while the 3rd checkpoint file of the process is written —
+    # mid-save of tag global_step4, after global_step2 committed
+    ckpt = str(tmp_path / "ckpt")
+    killed = _run_trainer(script, ckpt, 6,
+                          faults='{"sigkill_mid_save": 3}')
+    assert killed.returncode == -signal.SIGKILL, (
+        killed.returncode, killed.stdout, killed.stderr[-2000:])
+    from deeperspeed_tpu.checkpoint.serialization import read_latest
+    assert read_latest(ckpt) == "global_step2"
+    assert is_committed(os.path.join(ckpt, "global_step2"))
+    ok, problems = verify_manifest(os.path.join(ckpt, "global_step2"))
+    assert ok, problems
+    assert tag_status(os.path.join(ckpt, "global_step4")) != "committed"
+
+    # run 2 (the supervisor restart): resumes from step 2 and the losses
+    # match the uninterrupted run bit-for-bit
+    resumed = _run_trainer(script, ckpt, 6)
+    assert resumed.returncode == 0, resumed.stderr[-2000:]
+    res_losses = _losses(resumed.stdout)
+    assert sorted(res_losses) == [2, 3, 4, 5]
+    for i in range(2, 6):
+        assert res_losses[i] == ref_losses[i], (
+            f"step {i}: resumed {res_losses[i]} != reference {ref_losses[i]}")
+
+
+@pytest.mark.slow
+def test_resilience_drill_full(tmp_path):
+    """Full scripts/resilience_drill.py run: save-stall benchmark (async
+    blocked < 25% of sync) + supervised kill-and-resume drill."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = str(tmp_path / "BENCH_resilience.json")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "scripts", "resilience_drill.py"),
+         "--out", out],
+        capture_output=True, text=True, timeout=1200,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    with open(out) as f:
+        report = json.load(f)
+    assert report["drill"]["pass"]
+    assert report["blocked_ratio"] < 0.25
+    assert report["blocked_vs_legacy_ratio"] < 0.25
+    assert report["drill"]["losses_match_reference"]
