@@ -1,0 +1,34 @@
+"""datapipe: streaming, prefetching, checkpointable host input pipeline.
+
+Enabled by a ``"datapipe"`` block in the DeepSpeed-style config (same
+convention as ``"monitor"`` and ``"resilience"``: presence enables
+unless ``"enabled": false``). The engine builds one :class:`DataPipe`
+at init, pulls global batches from it in ``train_batch``, carries its
+:class:`DataState` inside every checkpoint, and restores it on
+``load_checkpoint`` — giving bit-identical batch order across resumes,
+including a mid-epoch SIGKILL with batches staged in the prefetch queue.
+"""
+
+from .collator import SequencePacker, stack_collate
+from .config import DataPipeConfig
+from .curriculum import CurriculumStage, SeqLenCurriculum, batch_size_at
+from .dataset import TokenShardDataset, epoch_order, order_fingerprint
+from .pipeline import DataPipe, build_datapipe
+from .prefetcher import AsyncPrefetcher
+from .state import DataState
+
+__all__ = [
+    "AsyncPrefetcher",
+    "CurriculumStage",
+    "DataPipe",
+    "DataPipeConfig",
+    "DataState",
+    "SeqLenCurriculum",
+    "SequencePacker",
+    "TokenShardDataset",
+    "batch_size_at",
+    "build_datapipe",
+    "epoch_order",
+    "order_fingerprint",
+    "stack_collate",
+]

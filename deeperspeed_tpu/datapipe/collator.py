@@ -1,0 +1,90 @@
+"""Collation: fixed-window stacking and ragged-document packing.
+
+``stack_collate`` is the fixed-shape fast path (re-exported from the
+legacy loader so the two cannot diverge). ``SequencePacker`` handles
+ragged documents: tokens from consecutive documents are packed greedily,
+in order, into fixed ``(rows, seq_len + 1)`` batches with an optional
+EOS separator and per-token segment ids, so short documents stop
+wasting the padded tail of every row. Packing is deterministic — same
+document stream, same packed batches — which keeps it compatible with
+the checkpointable cursor (the cursor counts documents consumed, and a
+resume replays the identical fill pattern).
+"""
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..runtime.dataloader import _default_collate as stack_collate
+
+__all__ = ["SequencePacker", "stack_collate"]
+
+
+class SequencePacker:
+    """Greedy in-order packer of 1-D token arrays into fixed rows.
+
+    A document longer than the space left in a row spills into the next
+    row, where its continuation becomes that row's segment 1. Segment
+    ids are 1-based per row; 0 marks padding — usable directly as an
+    attention-mask key or a loss mask.
+    """
+
+    def __init__(self, seq_len: int, pad_id: int = 0,
+                 eos_id: Optional[int] = None, dtype=np.int32):
+        self.row_len = int(seq_len) + 1
+        self.pad_id = int(pad_id)
+        self.eos_id = eos_id
+        self.dtype = np.dtype(dtype)
+
+    def doc_tokens(self, doc) -> np.ndarray:
+        doc = np.asarray(doc).reshape(-1)
+        if self.eos_id is not None:
+            doc = np.concatenate(
+                [doc, np.array([self.eos_id], dtype=doc.dtype)])
+        return doc
+
+    def pack(self, docs: List[np.ndarray],
+             rows: int) -> Tuple[np.ndarray, np.ndarray, int]:
+        """Pack ``docs`` into ``(tokens, segment_ids, docs_used)``.
+
+        Fills exactly ``rows`` rows of ``seq_len + 1`` tokens and
+        reports how many documents were consumed — the caller advances
+        its cursor by that count. Unconsumed documents are NOT buffered
+        (the cursor re-reads them next batch), so no hidden carry state
+        escapes the checkpoint.
+        """
+        tokens = np.full((rows, self.row_len), self.pad_id, self.dtype)
+        segs = np.zeros((rows, self.row_len), np.int32)
+        r, col, seg = 0, 0, 0
+        used = 0
+        for doc in docs:
+            flat = self.doc_tokens(doc)
+            if r >= rows:
+                break
+            # a doc that cannot start in the remaining space of the
+            # LAST row is left for the next batch; mid-batch it spills
+            # into the next row instead
+            if col >= self.row_len:
+                r, col, seg = r + 1, 0, 0
+                if r >= rows:
+                    break
+            seg += 1
+            pos = 0
+            while pos < flat.size and r < rows:
+                space = self.row_len - col
+                take = min(space, flat.size - pos)
+                tokens[r, col:col + take] = flat[pos:pos + take]
+                segs[r, col:col + take] = seg
+                col += take
+                pos += take
+                if col >= self.row_len and pos < flat.size:
+                    r, col = r + 1, 0
+                    seg = 1  # new row restarts segment numbering
+            if pos < flat.size:
+                # ran out of rows mid-document: the partial copy stands
+                # (it filled the batch exactly); the doc still counts as
+                # consumed to keep the cursor strictly advancing
+                used += 1
+                break
+            used += 1
+        return tokens, segs, max(used, 1)

@@ -1,0 +1,104 @@
+"""Curriculum stage: seq-len warmup composed with the batch-size warmup.
+
+``SeqLenCurriculum`` mirrors the shape of
+``runtime/bs_schedules.BatchSizeScheduler``: piecewise-constant stages
+spread linearly over ``warmup_steps``, growing from ``start_seq_len``
+to the full ``seq_len``. ``CurriculumStage`` applies both warmups to a
+produced batch **without changing its array shape** (the TPU rule: one
+compiled step, masked inactive work, no retrace per stage):
+
+  * columns past the scheduled seq-len are overwritten with ``pad_id``;
+  * rows past the scheduled batch size (read off an attached
+    ``BatchSizeScheduler``'s static schedule) are overwritten with
+    ``pad_id``.
+
+Both reads are **pure functions of the DataState step**, not of live
+scheduler objects — a prefetched batch produced two steps ahead is
+shaped for the step that will consume it, and a resumed run reproduces
+the identical masking because the step rides in the checkpoint.
+"""
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["SeqLenCurriculum", "CurriculumStage", "batch_size_at"]
+
+
+def batch_size_at(schedule: List[Tuple[int, int]], step: int) -> int:
+    """Scheduled batch size at ``step`` from a BatchSizeScheduler's
+    static ``schedule`` — the pure counterpart of its stateful
+    ``get_current_batch_size`` (which reads ``last_batch_iteration``)."""
+    bs = schedule[0][1]
+    for start, stage_bs in schedule:
+        if step >= start:
+            bs = stage_bs
+    return bs
+
+
+class SeqLenCurriculum:
+    def __init__(self, final_seq_len: int, start_seq_len: int,
+                 warmup_steps: int = 1000, num_intervals: int = 4):
+        self.final_seq_len = int(final_seq_len)
+        self.start_seq_len = int(start_seq_len)
+        self.warmup_steps = int(warmup_steps)
+        self.schedule = self._build(max(int(num_intervals), 1))
+
+    def _build(self, n: int) -> List[Tuple[int, int]]:
+        stages: List[Tuple[int, int]] = []
+        for i in range(n):
+            frac = i / (n - 1) if n > 1 else 1.0
+            step = round(frac * self.warmup_steps)
+            sl = round(self.start_seq_len
+                       + frac * (self.final_seq_len - self.start_seq_len))
+            if not stages or stages[-1][1] != sl:
+                stages.append((step, sl))
+        return stages
+
+    def seq_len_at(self, step: int) -> int:
+        return batch_size_at(self.schedule, step)
+
+
+class CurriculumStage:
+    """Applies the seq-len and batch-size warmups to one token batch."""
+
+    def __init__(self, curriculum: Optional[SeqLenCurriculum],
+                 bs_schedule: Optional[List[Tuple[int, int]]] = None,
+                 pad_id: int = 0):
+        self.curriculum = curriculum
+        self.bs_schedule = bs_schedule
+        self.pad_id = int(pad_id)
+
+    @property
+    def active(self) -> bool:
+        return self.curriculum is not None or self.bs_schedule is not None
+
+    def plan(self, step: int, rows: int, seq_len: int) -> Tuple[int, int]:
+        """(active_rows, active_seq_len) scheduled for ``step``."""
+        active_rows = rows
+        if self.bs_schedule:
+            active_rows = min(rows, batch_size_at(self.bs_schedule, step))
+        active_seq = seq_len
+        if self.curriculum is not None:
+            active_seq = min(seq_len, self.curriculum.seq_len_at(step))
+        return active_rows, active_seq
+
+    def apply(self, tokens: np.ndarray, step: int) -> np.ndarray:
+        """Mask inactive rows/columns to pad_id, shape unchanged. Only
+        plain 2-D token batches are maskable; anything else (tuple/dict
+        pytrees from user collate_fns) passes through untouched."""
+        if not self.active or not isinstance(tokens, np.ndarray) \
+                or tokens.ndim != 2:
+            return tokens
+        rows, width = tokens.shape
+        active_rows, active_seq = self.plan(step, rows, width - 1)
+        if active_rows >= rows and active_seq >= width - 1:
+            return tokens
+        out = np.array(tokens, copy=True)
+        if active_seq < width - 1:
+            # width is seq_len + 1 (inputs + shifted targets): keep
+            # active_seq + 1 tokens so the last target survives
+            out[:, active_seq + 1:] = self.pad_id
+        if active_rows < rows:
+            out[active_rows:, :] = self.pad_id
+        return out

@@ -1,0 +1,269 @@
+"""DataPipe: the engine-facing composition of the input subsystem.
+
+One pipe per engine binds the pieces together:
+
+  * a sample source — ``TokenShardDataset`` built from
+    ``datapipe.source``, or any indexable dataset handed to
+    ``initialize(training_data=...)``;
+  * the counter-based epoch order (``dataset.epoch_order``) and the
+    explicit ``DataState`` cursor over it;
+  * the curriculum stage (seq-len warmup composed with the engine's
+    ``bs_schedules`` batch-size schedule) and the collator (stacking or
+    ragged-document packing);
+  * the async prefetcher, which also **stages the batch onto the mesh**
+    (the engine's ``P('data')`` placement path) from the producer
+    thread while the current step runs;
+  * monitor wiring: ``datapipe/wait`` trace spans plus the
+    ``datapipe_host_stall_seconds`` histogram/gauge and
+    ``datapipe_queue_depth`` gauge so input starvation is visible in
+    traces and on ``/metrics``.
+
+Determinism contract: ``_make_batch`` is a pure function of
+``(DataState, dataset, config)``. The pipe's public state advances only
+when the step loop consumes a batch, so the state a checkpoint captures
+at a step boundary names exactly the next batch a resumed run will
+produce — staged-but-unconsumed batches are recomputed after restore,
+bit-identically, from the same counters.
+"""
+
+import time
+from typing import Any, Callable, List, Optional, Tuple
+
+import numpy as np
+
+from ..monitor import get_monitor, trace_span
+from ..utils.logging import logger
+from .collator import SequencePacker, stack_collate
+from .config import DataPipeConfig
+from .curriculum import CurriculumStage, SeqLenCurriculum
+from .dataset import TokenShardDataset, epoch_order, order_fingerprint
+from .prefetcher import AsyncPrefetcher
+from .state import DataState
+
+__all__ = ["DataPipe", "build_datapipe"]
+
+
+class DataPipe:
+    def __init__(
+        self,
+        dataset,
+        cfg: DataPipeConfig,
+        global_rows: int,
+        place_fn: Optional[Callable[[Any], Any]] = None,
+        bs_schedule: Optional[List[Tuple[int, int]]] = None,
+        collate_fn: Optional[Callable] = None,
+    ):
+        if global_rows < 1:
+            raise ValueError(f"global_rows must be >= 1, got {global_rows}")
+        n = len(dataset)
+        if not cfg.pack_sequences and global_rows > n:
+            raise ValueError(
+                f"global batch of {global_rows} rows exceeds the dataset "
+                f"({n} samples); shrink the batch or add data")
+        self.dataset = dataset
+        self.cfg = cfg
+        self.global_rows = int(global_rows)
+        self.place_fn = place_fn if cfg.stage_to_device else None
+        self.collate_fn = collate_fn or stack_collate
+        self.packer = (
+            SequencePacker(cfg.seq_len, pad_id=cfg.pad_id, eos_id=cfg.eos_id)
+            if cfg.pack_sequences else None)
+        curriculum = None
+        if cfg.curriculum is not None:
+            cur = dict(cfg.curriculum)
+            curriculum = SeqLenCurriculum(
+                final_seq_len=cfg.seq_len,
+                start_seq_len=int(cur.get("start_seq_len", cfg.seq_len)),
+                warmup_steps=int(cur.get("warmup_steps", 1000)),
+                num_intervals=int(cur.get("num_intervals", 4)))
+        self.stage = CurriculumStage(curriculum, bs_schedule=bs_schedule,
+                                     pad_id=cfg.pad_id)
+        self.state = DataState(
+            seed=cfg.seed,
+            fingerprint=self._fingerprint(cfg.seed, 0))
+        self._order_cache: Tuple[Optional[tuple], Optional[np.ndarray]] = (
+            None, None)
+        self._prefetcher: Optional[AsyncPrefetcher] = None
+        self._prod_state: DataState = self.state
+        self.last_stall_seconds = 0.0
+        if cfg.prefetch:
+            self._start_prefetcher()
+
+    # ---------------------------------------------------------------- #
+    # deterministic production
+    # ---------------------------------------------------------------- #
+
+    def _identity(self) -> Optional[dict]:
+        ident = getattr(self.dataset, "identity", None)
+        return ident() if callable(ident) else None
+
+    def _fingerprint(self, seed: int, epoch: int) -> str:
+        return order_fingerprint(seed, epoch, len(self.dataset),
+                                 shuffle=self.cfg.shuffle,
+                                 identity=self._identity())
+
+    def _order_for(self, seed: int, epoch: int) -> np.ndarray:
+        # keyed by the STATE's seed, not the config's: a checkpoint
+        # restored under a different configured seed must still replay
+        # the stream it was saved from (checkpoint wins)
+        cached_key, order = self._order_cache
+        if cached_key != (seed, epoch) or order is None:
+            order = epoch_order(seed, epoch, len(self.dataset),
+                                shuffle=self.cfg.shuffle)
+            self._order_cache = ((seed, epoch), order)
+        return order
+
+    def _wrap_epoch(self, st: DataState) -> DataState:
+        return DataState(
+            epoch=st.epoch + 1, cursor=0, step=st.step,
+            samples=st.samples, seed=st.seed,
+            fingerprint=self._fingerprint(st.seed, st.epoch + 1))
+
+    def _make_batch(self, st: DataState) -> Tuple[Any, DataState]:
+        """Pure: (state) -> (collated+masked batch, state after it)."""
+        rows = self.global_rows
+        n = len(self.dataset)
+        if self.packer is None and st.cursor + rows > n:
+            st = self._wrap_epoch(st)  # drop the ragged tail
+        order = self._order_for(st.seed, st.epoch)
+        if self.packer is not None:
+            docs = [self.dataset[int(i)] for i in order[st.cursor:]]
+            tokens, segs, used = self.packer.pack(docs, rows)
+            batch = {"tokens": self.stage.apply(tokens, st.step),
+                     "segment_ids": segs}
+            next_st = DataState(
+                epoch=st.epoch, cursor=st.cursor + used, step=st.step + 1,
+                samples=st.samples + used, seed=st.seed,
+                fingerprint=st.fingerprint)
+            if next_st.cursor >= n:
+                next_st = self._wrap_epoch(next_st)
+            return batch, next_st
+        idx = order[st.cursor:st.cursor + rows]
+        samples = [self.dataset[int(i)] for i in idx]
+        batch = self.stage.apply(self.collate_fn(samples), st.step)
+        next_st = DataState(
+            epoch=st.epoch, cursor=st.cursor + rows, step=st.step + 1,
+            samples=st.samples + rows, seed=st.seed,
+            fingerprint=st.fingerprint)
+        return batch, next_st
+
+    def _produce(self):
+        """Producer-thread body: build the next batch from the producer
+        cursor and stage it on the mesh while the current step runs."""
+        batch, next_st = self._make_batch(self._prod_state)
+        self._prod_state = next_st
+        placed = False
+        if self.place_fn is not None:
+            batch = self.place_fn(batch)
+            placed = True
+        return batch, next_st, placed
+
+    # ---------------------------------------------------------------- #
+    # the step loop's view
+    # ---------------------------------------------------------------- #
+
+    def _start_prefetcher(self) -> None:
+        self._prod_state = self.state
+        self._prefetcher = AsyncPrefetcher(
+            self._produce, depth=self.cfg.prefetch_depth)
+
+    def next_global_batch(self) -> Tuple[Any, bool]:
+        """The next global batch and whether it is already placed on the
+        mesh. Blocks only while the host is genuinely behind; the wait is
+        recorded as the step's host stall."""
+        with trace_span("datapipe/wait", lane="datapipe",
+                        step=self.state.step):
+            if self._prefetcher is not None:
+                (batch, next_st, placed), wait = self._prefetcher.get()
+            else:
+                t0 = time.perf_counter()
+                batch, next_st, placed = self._produce()
+                wait = time.perf_counter() - t0
+        self.state = next_st
+        self.last_stall_seconds = wait
+        self._record_metrics(wait)
+        return batch, placed
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self.next_global_batch()[0]
+
+    def _record_metrics(self, wait: float) -> None:
+        mon = get_monitor()
+        if mon is None:
+            return
+        from ..monitor.metrics import DEFAULT_STALL_BUCKETS
+
+        reg = mon.registry
+        reg.counter("datapipe_batches_total",
+                    "global batches handed to the step loop").inc()
+        reg.gauge("datapipe_host_stall_seconds",
+                  "host time the last step blocked waiting on input"
+                  ).set(wait)
+        reg.histogram("datapipe_host_stall_seconds_hist",
+                      "host-blocked time per step waiting on input",
+                      buckets=DEFAULT_STALL_BUCKETS).observe(wait)
+        reg.gauge("datapipe_queue_depth",
+                  "staged global batches ready for the step loop").set(
+            self._prefetcher.queued if self._prefetcher is not None else 0)
+        reg.gauge("datapipe_epoch", "current dataset epoch").set(
+            self.state.epoch)
+
+    # ---------------------------------------------------------------- #
+    # checkpointable state
+    # ---------------------------------------------------------------- #
+
+    def state_dict(self) -> dict:
+        return self.state.to_dict()
+
+    def load_state_dict(self, sd: dict) -> None:
+        """Restore the iteration cursor. Any staged batches are dropped
+        and re-produced from the restored counters — that recomputation
+        is what makes resume bit-identical even after a mid-epoch kill
+        with batches in flight."""
+        st = DataState.from_dict(sd)
+        expect = self._fingerprint(st.seed, st.epoch)
+        if st.fingerprint and st.fingerprint != expect:
+            logger.warning(
+                "datapipe: restored DataState fingerprint %s does not "
+                "match this dataset/seed (%s) — the corpus, seed, or "
+                "shuffle setting changed since the checkpoint; the "
+                "resumed batch stream will NOT replay the original run",
+                st.fingerprint, expect)
+        self.state = DataState(
+            epoch=st.epoch, cursor=st.cursor, step=st.step,
+            samples=st.samples, seed=st.seed, fingerprint=expect)
+        if self._prefetcher is not None:
+            self._prefetcher.close()
+            self._start_prefetcher()
+        else:
+            self._prod_state = self.state
+
+    def close(self) -> None:
+        if self._prefetcher is not None:
+            self._prefetcher.close()
+            self._prefetcher = None
+
+
+def build_datapipe(
+    cfg: DataPipeConfig,
+    dataset=None,
+    global_rows: int = 1,
+    place_fn=None,
+    bs_schedule=None,
+    collate_fn=None,
+) -> DataPipe:
+    """Build a DataPipe from the config block. ``dataset`` (an indexable
+    of samples, e.g. ``initialize(training_data=...)``) wins over
+    ``cfg.source``; with neither there is nothing to iterate."""
+    if dataset is None:
+        if cfg.source is None:
+            raise ValueError(
+                'the "datapipe" block needs a "source" (token .npy file '
+                "or shard directory) when initialize() gets no "
+                "training_data")
+        dataset = TokenShardDataset(cfg.source, cfg.seq_len)
+    return DataPipe(dataset, cfg, global_rows, place_fn=place_fn,
+                    bs_schedule=bs_schedule, collate_fn=collate_fn)

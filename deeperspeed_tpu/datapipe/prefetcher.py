@@ -1,0 +1,90 @@
+"""Async double-buffered prefetcher.
+
+One daemon producer thread runs ``produce()`` (index gather + collation
++ curriculum masking + optional device staging) and parks finished
+global batches in a bounded queue. The step loop's only host work per
+step is a queue pop — the ``wait`` it reports is exactly the host time
+the device sat starved for input, which the pipeline exports as
+``datapipe_host_stall_seconds``.
+
+The same overlap principle the engine applies to compute/collectives
+applies here one level up: input staging is tracked (the queue) and
+triggered (the producer) asynchronously so the device never waits on
+the host. ``jax.device_put`` is safe to call off-thread — dispatch is
+thread-safe and the transfer overlaps the running step.
+
+Error contract: a producer exception is caught, parked, and re-raised
+on the consumer's next ``get()`` — never swallowed by the thread.
+"""
+
+import queue
+import threading
+import time
+from typing import Any, Callable, Tuple
+
+__all__ = ["AsyncPrefetcher"]
+
+_OK, _ERR = 0, 1
+
+
+class AsyncPrefetcher:
+    def __init__(self, produce: Callable[[], Any], depth: int = 2,
+                 name: str = "datapipe-prefetch"):
+        self._produce = produce
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, int(depth)))
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, name=name,
+                                        daemon=True)
+        self._thread.start()
+
+    # ---- producer side ---------------------------------------------- #
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                item = self._produce()
+            except BaseException as e:  # noqa: BLE001 - parked for consumer
+                self._put((_ERR, e))
+                return
+            if not self._put((_OK, item)):
+                return
+
+    def _put(self, item) -> bool:
+        """Bounded put that stays responsive to close()."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    # ---- consumer side ---------------------------------------------- #
+
+    def get(self) -> Tuple[Any, float]:
+        """(next item, seconds the caller blocked waiting for it)."""
+        if self._stop.is_set():
+            raise RuntimeError("prefetcher is closed")
+        t0 = time.perf_counter()
+        kind, item = self._q.get()
+        wait = time.perf_counter() - t0
+        if kind == _ERR:
+            self._stop.set()
+            raise item
+        return item, wait
+
+    @property
+    def queued(self) -> int:
+        return self._q.qsize()
+
+    def close(self) -> None:
+        """Stop the producer and drop staged batches. Safe to call
+        twice; used on restore (staged batches predate the restored
+        cursor and must not be consumed) and at preemption."""
+        self._stop.set()
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5)

@@ -1,0 +1,44 @@
+"""DataState: the explicit, checkpointable iteration cursor.
+
+Everything needed to reproduce the remaining batch stream after a
+restart — including a mid-epoch SIGKILL — is five integers and a
+fingerprint:
+
+  * ``epoch``      — which counter-based permutation is in effect;
+  * ``cursor``     — samples already consumed from this epoch's order;
+  * ``step``       — global batches produced (drives the curriculum and
+    the batch-size schedule composition, so prefetched batches are
+    shaped for the step that will consume them);
+  * ``samples``    — lifetime samples consumed (bookkeeping/metrics);
+  * ``seed``       — the shuffle seed the stream was built with;
+  * ``fingerprint``— hash of the CURRENT epoch's order + dataset
+    identity, verified at restore so a changed corpus/seed is loud.
+
+The state advances only when a batch is **handed to the step loop**,
+never when the prefetcher merely produces it — so a checkpoint taken at
+a step boundary always points at exactly the first batch the resumed
+run must consume, regardless of how many batches sat staged in the
+queue when the process died.
+"""
+
+import dataclasses
+
+__all__ = ["DataState"]
+
+
+@dataclasses.dataclass(frozen=True)
+class DataState:
+    epoch: int = 0
+    cursor: int = 0
+    step: int = 0
+    samples: int = 0
+    seed: int = 0
+    fingerprint: str = ""
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "DataState":
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in (d or {}).items() if k in known})

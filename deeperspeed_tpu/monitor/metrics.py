@@ -38,6 +38,7 @@ __all__ = [
     "export_to_tensorboard",
     "DEFAULT_LATENCY_BUCKETS",
     "DEFAULT_SAVE_BUCKETS",
+    "DEFAULT_STALL_BUCKETS",
 ]
 
 # seconds; spans sub-ms decode steps to multi-second TTFT tails
@@ -51,6 +52,14 @@ DEFAULT_LATENCY_BUCKETS = (
 DEFAULT_SAVE_BUCKETS = (
     0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
     10.0, 30.0, 60.0, 120.0,
+)
+
+# seconds; per-step host-blocked input wait (datapipe) — a healthy
+# prefetched pipe sits in the sub-ms buckets, a host-bound one in the
+# tens/hundreds of ms
+DEFAULT_STALL_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
 )
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"

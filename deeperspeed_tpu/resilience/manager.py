@@ -275,6 +275,13 @@ class ResilienceManager:
                         len(leftover))
             except Exception as e:  # noqa: BLE001
                 logger.error("serving drain failed: %s", e)
+        if getattr(engine, "datapipe", None) is not None:
+            # stop the prefetch thread before exiting; staged batches are
+            # recomputed from the checkpointed DataState on resume
+            try:
+                engine.datapipe.close()
+            except Exception as e:  # noqa: BLE001
+                logger.error("datapipe close failed: %s", e)
         if self.guard is not None:
             self.guard.uninstall()
         raise SystemExit(self.cfg.preemption_exit_code)

@@ -355,6 +355,34 @@ class TrainingConfig:
             except ValueError as e:
                 raise ConfigError(f'invalid "resilience" block: {e}') from e
 
+        # ---- datapipe (streaming / prefetching host input pipeline) ----
+        # A "datapipe" block turns on the input subsystem (datapipe/
+        # package): memory-mapped token shards, async prefetch with
+        # device staging, checkpointable DataState, curriculum +
+        # packing. Validated eagerly like "serving"/"monitor".
+        self.datapipe_params = pd.get(c.DATAPIPE, None)
+        if self.datapipe_params is not None and not isinstance(
+                self.datapipe_params, dict):
+            raise ConfigError(
+                '"datapipe" must be a dict of DataPipeConfig '
+                'overrides (or {"enabled": false})'
+            )
+        explicit_datapipe = (self.datapipe_params or {}).get(
+            c.DATAPIPE_ENABLED)
+        self.datapipe_enabled = (
+            explicit_datapipe if explicit_datapipe is not None
+            else self.datapipe_params is not None
+        )
+        self._datapipe_config = None
+        if self.datapipe_enabled:
+            from ..datapipe.config import DataPipeConfig
+
+            try:
+                self._datapipe_config = DataPipeConfig.from_dict(
+                    dict(self.datapipe_params, enabled=True))
+            except ValueError as e:
+                raise ConfigError(f'invalid "datapipe" block: {e}') from e
+
         # ---- fused Pallas kernels ----
         # A "kernels" block selects the fused elementwise/optimizer/
         # super-tile attention kernels (ops/kernel_config.py): mode
@@ -402,6 +430,11 @@ class TrainingConfig:
         """The "resilience" block as a ResilienceConfig (None when
         absent or disabled); validated at parse time like "serving"."""
         return self._resilience_config
+
+    def datapipe_config(self):
+        """The "datapipe" block as a DataPipeConfig (None when absent
+        or disabled); validated at parse time like "serving"."""
+        return self._datapipe_config
 
     def get_sparse_attention(self, num_heads: int):
         """Build the configured SparsityConfig (reference runtime/config.py:213

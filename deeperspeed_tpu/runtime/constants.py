@@ -254,3 +254,14 @@ KERNELS_MODE_DEFAULT = "off"
 RESILIENCE = "resilience"
 RESILIENCE_ENABLED = "enabled"
 RESILIENCE_ENABLED_DEFAULT = False
+
+#############################################
+# Host input pipeline (datapipe/ package): streaming token-shard
+# dataset, async double-buffered prefetch with device staging,
+# checkpointable DataState cursor, seq-len curriculum + sequence
+# packing. Keys are validated by datapipe.config.DataPipeConfig
+# .from_dict; block presence enables unless {"enabled": false}.
+#############################################
+DATAPIPE = "datapipe"
+DATAPIPE_ENABLED = "enabled"
+DATAPIPE_ENABLED_DEFAULT = False

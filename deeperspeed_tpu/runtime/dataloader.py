@@ -16,8 +16,15 @@ import numpy as np
 
 class RepeatingLoader:
     def __init__(self, loader):
-        """Wrap an iterator to restart from the beginning when it ends."""
+        """Wrap an iterator to restart from the beginning when it ends.
+
+        Each wrap-around counts as a new epoch: loaders that expose
+        ``set_epoch`` (DeepSpeedDataLoader, torch samplers) are advanced
+        so a shuffling loader reshuffles every pass instead of replaying
+        epoch 0's order forever.
+        """
         self.loader = loader
+        self.epoch = 0
         self.data_iter = iter(self.loader)
 
     def __iter__(self):
@@ -27,6 +34,9 @@ class RepeatingLoader:
         try:
             batch = next(self.data_iter)
         except StopIteration:
+            self.epoch += 1
+            if hasattr(self.loader, "set_epoch"):
+                self.loader.set_epoch(self.epoch)
             self.data_iter = iter(self.loader)
             batch = next(self.data_iter)
         return batch
@@ -53,6 +63,14 @@ class DeepSpeedDataLoader:
         data_parallel_world_size=None,
         data_parallel_rank=None,
     ):
+        n = len(dataset)
+        if not isinstance(batch_size, int) or batch_size <= 0:
+            raise ValueError(
+                f"batch_size must be a positive int, got {batch_size!r}")
+        if batch_size > n:
+            raise ValueError(
+                f"batch_size {batch_size} exceeds the dataset ({n} samples); "
+                "every batch would be short — shrink the batch or add data")
         self.dataset = dataset
         self.batch_size = batch_size
         self.shuffle = shuffle
@@ -60,7 +78,6 @@ class DeepSpeedDataLoader:
         self.drop_last = drop_last
         self.collate_fn = collate_fn or _default_collate
         self.epoch = 0
-        n = len(dataset)
         self.len = n // batch_size if drop_last else math.ceil(n / batch_size)
 
     def __len__(self):

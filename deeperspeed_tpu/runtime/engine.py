@@ -286,9 +286,32 @@ class Engine(ConfigAccessorsMixin):
 
         self.state = self._init_state(params)
 
-        # dataloader
+        # datapipe (datapipe/ package): a "datapipe" config block swaps
+        # the sync dataloader pull for the streaming/prefetching host
+        # pipeline — memory-mapped shards or initialize(training_data=),
+        # async device staging, checkpointable DataState (carried in
+        # _host_checkpoint_payload, restored by load_checkpoint)
+        self.datapipe = None
+        if config.datapipe_config() is not None:
+            from ..datapipe import build_datapipe
+
+            self.datapipe = build_datapipe(
+                config.datapipe_config(),
+                dataset=training_data,
+                global_rows=(self.train_micro_batch_size_per_gpu()
+                             * self.data_parallel_size
+                             * self.gradient_accumulation_steps()),
+                place_fn=self._place_batch,
+                bs_schedule=(self.batch_size_scheduler.schedule
+                             if self.batch_size_scheduler is not None
+                             else None),
+                collate_fn=collate_fn,
+            )
+
+        # dataloader (legacy sync path; the datapipe owns the data when
+        # its block is configured)
         self.training_dataloader = None
-        if training_data is not None:
+        if training_data is not None and self.datapipe is None:
             self.training_dataloader = self.deepspeed_io(
                 training_data, collate_fn=collate_fn
             )
@@ -1062,11 +1085,20 @@ class Engine(ConfigAccessorsMixin):
         """Fused one-step API (the TPU-native hot path). Accepts either a full
         global batch (leading dim = gas * micro * dp) or pulls one from the
         engine dataloader / provided iterator."""
+        placed = False
         if batch is None:
-            it = data_iter or self._train_iter()
-            parts = [next(it) for _ in range(self.gradient_accumulation_steps())]
-            batch = jax.tree.map(lambda *xs: np.concatenate(xs, axis=0), *parts)
-        batch = self._place_batch(batch)
+            if self.datapipe is not None and data_iter is None:
+                # the pipe hands over a full global batch, usually
+                # already staged on the mesh by the prefetch thread
+                batch, placed = self.datapipe.next_global_batch()
+            else:
+                it = data_iter or self._train_iter()
+                parts = [next(it)
+                         for _ in range(self.gradient_accumulation_steps())]
+                batch = jax.tree.map(
+                    lambda *xs: np.concatenate(xs, axis=0), *parts)
+        if not placed:
+            batch = self._place_batch(batch)
         batch = self._pack_pld(batch)
         rng = self._rng_args()
         lr = np.float32(self._current_lr())
@@ -1299,6 +1331,10 @@ class Engine(ConfigAccessorsMixin):
             "lr_scheduler": (
                 self.lr_scheduler.state_dict() if self.lr_scheduler else {}
             ),
+            "datapipe": (
+                self.datapipe.state_dict() if self.datapipe is not None
+                else {}
+            ),
             "client_state": client_state or {},
         }
         optim_states = {
@@ -1409,6 +1445,10 @@ class Engine(ConfigAccessorsMixin):
                 "zero_stage": self.zero_stage,
                 "lr_scheduler": (
                     self.lr_scheduler.state_dict() if self.lr_scheduler else {}
+                ),
+                "datapipe": (
+                    self.datapipe.state_dict() if self.datapipe is not None
+                    else {}
                 ),
                 "client_state": client_state or {},
             }
@@ -1536,6 +1576,8 @@ class Engine(ConfigAccessorsMixin):
             self.batch_size_scheduler.step(self.global_steps)
         self.global_samples = int(meta.get("global_samples", 0))
         self.micro_steps = int(meta.get("micro_steps", 0))
+        if self.datapipe is not None and meta.get("datapipe"):
+            self.datapipe.load_state_dict(meta["datapipe"])
         if (load_lr_scheduler_states and self.lr_scheduler is not None
                 and meta.get("lr_scheduler")):
             self.lr_scheduler.load_state_dict(meta["lr_scheduler"])
@@ -1660,6 +1702,8 @@ class Engine(ConfigAccessorsMixin):
             self.batch_size_scheduler.step(self.global_steps)
         self.global_samples = int(model_states.get("global_samples", 0))
         self.micro_steps = int(model_states.get("micro_steps", 0))
+        if self.datapipe is not None and model_states.get("datapipe"):
+            self.datapipe.load_state_dict(model_states["datapipe"])
         if (
             load_lr_scheduler_states
             and self.lr_scheduler is not None

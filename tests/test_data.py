@@ -82,3 +82,48 @@ def test_engine_dataloader_integration():
     for _ in range(20):
         l = float(engine.train_batch())
     assert l < l0
+
+
+def test_repeating_loader_reshuffles_each_epoch():
+    # regression: wrap-around used to restart the shuffling loader
+    # without advancing its epoch, replaying epoch 0's order forever
+    ds = _tuple_dataset(16)
+    dl = DeepSpeedDataLoader(ds, batch_size=4, shuffle=True, seed=7)
+    loader = RepeatingLoader(dl)
+    epoch0 = [next(loader)[1].tolist() for _ in range(4)]
+    epoch1 = [next(loader)[1].tolist() for _ in range(4)]
+    assert loader.epoch == 1
+    assert epoch1 != epoch0  # wrap-around reshuffled
+    assert sorted(sum(epoch1, [])) == list(range(16))  # still a permutation
+    # and the reshuffle is the deterministic epoch-1 order
+    dl.set_epoch(1)
+    assert [b[1].tolist() for b in dl] == epoch1
+
+
+def test_dataloader_rejects_bad_batch_size():
+    ds = _tuple_dataset(8)
+    with pytest.raises(ValueError, match="positive int"):
+        DeepSpeedDataLoader(ds, batch_size=0)
+    with pytest.raises(ValueError, match="positive int"):
+        DeepSpeedDataLoader(ds, batch_size=-4)
+    with pytest.raises(ValueError, match="positive int"):
+        DeepSpeedDataLoader(ds, batch_size=2.5)
+    with pytest.raises(ValueError, match="exceeds the dataset"):
+        DeepSpeedDataLoader(ds, batch_size=9)
+
+
+def test_default_collate_tuple_and_scalar():
+    out = _default_collate([(np.ones(2), np.int32(0)),
+                            (np.zeros(2), np.int32(1))])
+    assert isinstance(out, tuple) and len(out) == 2
+    assert out[0].shape == (2, 2) and out[1].tolist() == [0, 1]
+    scalars = _default_collate([np.float32(1.5), np.float32(2.5)])
+    assert scalars.shape == (2,) and scalars.tolist() == [1.5, 2.5]
+
+
+def test_default_collate_ragged_tail_contents():
+    ds = [np.full(3, i, np.int32) for i in range(10)]
+    dl = DeepSpeedDataLoader(ds, batch_size=4, drop_last=False)
+    batches = list(dl)
+    assert [b.shape[0] for b in batches] == [4, 4, 2]
+    np.testing.assert_array_equal(batches[-1][:, 0], [8, 9])

@@ -1,0 +1,564 @@
+"""datapipe/ subsystem: token-shard dataset, counter-based epoch order,
+sequence packing, curriculum masking, async prefetch, checkpointable
+DataState, engine integration, and the end-to-end mid-epoch
+SIGKILL-and-resume drill (subprocess, element-wise token comparison)."""
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeperspeed_tpu.datapipe import (
+    AsyncPrefetcher,
+    CurriculumStage,
+    DataPipe,
+    DataPipeConfig,
+    DataState,
+    SeqLenCurriculum,
+    SequencePacker,
+    TokenShardDataset,
+    batch_size_at,
+    build_datapipe,
+    epoch_order,
+    order_fingerprint,
+)
+
+
+# --------------------------------------------------------------------- #
+# dataset + deterministic order
+# --------------------------------------------------------------------- #
+
+
+def _tokens(n, start=0):
+    return (np.arange(start, start + n) % 50000).astype(np.uint16)
+
+
+def test_token_dataset_windows_from_array():
+    ds = TokenShardDataset(_tokens(101), seq_len=9)  # window = 10
+    assert len(ds) == 10  # ragged tail token dropped
+    w0 = ds[0]
+    assert w0.shape == (10,) and w0.dtype == np.int32
+    np.testing.assert_array_equal(w0, np.arange(10))
+    np.testing.assert_array_equal(ds[9], np.arange(90, 100))
+    with pytest.raises(IndexError):
+        ds[10]
+
+
+def test_token_dataset_file_and_shard_dir(tmp_path):
+    np.save(tmp_path / "single.npy", _tokens(40))
+    ds = TokenShardDataset(str(tmp_path / "single.npy"), seq_len=9)
+    assert len(ds) == 4
+
+    shard_dir = tmp_path / "shards"
+    shard_dir.mkdir()
+    # sorted-filename order is part of the contract; write out of order
+    np.save(shard_dir / "b_shard.npy", _tokens(25, start=1000))
+    np.save(shard_dir / "a_shard.npy", _tokens(35, start=0))
+    ds2 = TokenShardDataset(str(shard_dir), seq_len=9)
+    # a: 3 windows (5-token tail dropped), b: 2 windows — no straddling
+    assert len(ds2) == 5
+    np.testing.assert_array_equal(ds2[0], np.arange(10))
+    np.testing.assert_array_equal(ds2[3], np.arange(1000, 1010))
+    assert ds2.identity()["shards"] == ["a_shard.npy", "b_shard.npy"]
+
+
+def test_token_dataset_errors(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        TokenShardDataset(str(tmp_path / "nope.npy"), seq_len=4)
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    with pytest.raises(FileNotFoundError):
+        TokenShardDataset(str(empty), seq_len=4)
+    with pytest.raises(ValueError, match="no full window"):
+        TokenShardDataset(_tokens(5), seq_len=9)
+    np.save(tmp_path / "bad.npy", np.zeros((4, 4), np.int32))
+    with pytest.raises(ValueError, match="1-D"):
+        TokenShardDataset(str(tmp_path / "bad.npy"), seq_len=2)
+
+
+def test_epoch_order_is_pure_and_distinct():
+    a = epoch_order(7, 0, 100)
+    b = epoch_order(7, 0, 100)
+    np.testing.assert_array_equal(a, b)  # pure function of (seed, epoch)
+    assert not np.array_equal(a, epoch_order(7, 1, 100))
+    assert not np.array_equal(a, epoch_order(8, 0, 100))
+    assert sorted(a.tolist()) == list(range(100))
+    np.testing.assert_array_equal(
+        epoch_order(7, 0, 10, shuffle=False), np.arange(10))
+
+
+def test_order_fingerprint_binds_seed_epoch_identity():
+    fp = order_fingerprint(1, 0, 50)
+    assert fp == order_fingerprint(1, 0, 50)
+    assert fp != order_fingerprint(1, 1, 50)
+    assert fp != order_fingerprint(2, 0, 50)
+    assert fp != order_fingerprint(1, 0, 51)
+    assert fp != order_fingerprint(1, 0, 50, identity={"shards": ["x.npy"]})
+
+
+def test_data_state_round_trip_filters_unknown_keys():
+    st = DataState(epoch=2, cursor=48, step=17, samples=200, seed=5,
+                   fingerprint="abcd")
+    d = st.to_dict()
+    assert DataState.from_dict(d) == st
+    d["from_the_future"] = 1
+    assert DataState.from_dict(d) == st
+    assert DataState.from_dict({}) == DataState()
+
+
+# --------------------------------------------------------------------- #
+# packing + curriculum
+# --------------------------------------------------------------------- #
+
+
+def test_sequence_packer_layout_and_segments():
+    p = SequencePacker(seq_len=7, pad_id=-1, eos_id=9)  # rows of 8
+    docs = [np.arange(3), np.arange(2), np.arange(20)]
+    tokens, segs, used = p.pack(docs, rows=2)
+    assert used == 3
+    # row 0: doc0 (0 1 2 9) then doc1 (0 1 9) then doc2's first token
+    np.testing.assert_array_equal(tokens[0], [0, 1, 2, 9, 0, 1, 9, 0])
+    np.testing.assert_array_equal(segs[0], [1, 1, 1, 1, 2, 2, 2, 3])
+    # row 1: doc2's continuation becomes that row's segment 1
+    np.testing.assert_array_equal(tokens[1], np.arange(1, 9))
+    assert set(segs[1].tolist()) == {1}
+
+
+def test_sequence_packer_pads_when_docs_run_out():
+    p = SequencePacker(seq_len=7, pad_id=0)
+    tokens, segs, used = p.pack([np.array([5, 5, 5])], rows=2)
+    assert used == 1
+    np.testing.assert_array_equal(tokens[0], [5, 5, 5, 0, 0, 0, 0, 0])
+    assert segs[0].tolist() == [1, 1, 1, 0, 0, 0, 0, 0]
+    assert tokens[1].tolist() == [0] * 8 and segs[1].tolist() == [0] * 8
+
+
+def test_batch_size_at_reads_static_schedule():
+    sched = [(0, 2), (10, 4), (20, 8)]
+    assert batch_size_at(sched, 0) == 2
+    assert batch_size_at(sched, 9) == 2
+    assert batch_size_at(sched, 10) == 4
+    assert batch_size_at(sched, 25) == 8
+
+
+def test_seq_len_curriculum_stages():
+    cur = SeqLenCurriculum(final_seq_len=64, start_seq_len=8,
+                           warmup_steps=90, num_intervals=4)
+    assert cur.seq_len_at(0) == 8
+    assert cur.seq_len_at(10**6) == 64
+    lens = [cur.seq_len_at(s) for s in range(0, 120)]
+    assert lens == sorted(lens)  # monotone warmup
+    assert set(lens) == {8, 27, 45, 64}  # 4 piecewise-constant stages
+
+
+def test_curriculum_stage_masks_without_reshaping():
+    cur = SeqLenCurriculum(final_seq_len=8, start_seq_len=4,
+                           warmup_steps=10, num_intervals=2)
+    stage = CurriculumStage(cur, bs_schedule=[(0, 2), (10, 4)], pad_id=0)
+    batch = np.arange(1, 37).reshape(4, 9)  # rows=4, width=seq_len+1
+    early = stage.apply(batch, step=0)
+    assert early.shape == batch.shape  # TPU rule: no retrace per stage
+    # seq warmup keeps active_seq + 1 = 5 columns (last target survives)
+    assert (early[:2, 5:] == 0).all() and (early[:2, :5] != 0).all()
+    # batch-size warmup masks rows 2..4 entirely
+    assert (early[2:] == 0).all()
+    late = stage.apply(batch, step=50)
+    np.testing.assert_array_equal(late, batch)  # warmups over: untouched
+    # non-2D / dict pytrees pass through untouched
+    d = {"a": batch}
+    assert stage.apply(d, step=0) is d
+
+
+# --------------------------------------------------------------------- #
+# prefetcher
+# --------------------------------------------------------------------- #
+
+
+def test_prefetcher_orders_items_and_reports_wait():
+    counter = iter(range(100))
+    pf = AsyncPrefetcher(lambda: next(counter), depth=2)
+    got = [pf.get()[0] for _ in range(10)]
+    assert got == list(range(10))
+    _, wait = pf.get()
+    assert wait >= 0.0
+    pf.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        pf.get()
+
+
+def test_prefetcher_propagates_producer_error():
+    def boom():
+        raise OSError("shard unreadable")
+
+    pf = AsyncPrefetcher(boom, depth=2)
+    with pytest.raises(OSError, match="shard unreadable"):
+        pf.get()
+    pf.close()
+
+
+def test_prefetcher_close_unblocks_full_producer():
+    gate = threading.Event()
+
+    def produce():
+        gate.set()
+        return 1
+
+    pf = AsyncPrefetcher(produce, depth=1)
+    assert gate.wait(timeout=5)
+    time.sleep(0.05)  # let the producer block on the full queue
+    t0 = time.monotonic()
+    pf.close()
+    assert time.monotonic() - t0 < 5
+
+
+# --------------------------------------------------------------------- #
+# DataPipe: determinism, epoch wrap, checkpoint round trip
+# --------------------------------------------------------------------- #
+
+
+def _pipe_cfg(**kw):
+    base = dict(enabled=True, seq_len=9, seed=3, stage_to_device=False)
+    base.update(kw)
+    return DataPipeConfig.from_dict(base)
+
+
+def _drain(pipe, n):
+    return [pipe.next_global_batch()[0] for _ in range(n)]
+
+
+def test_datapipe_epoch_wrap_and_full_determinism():
+    ds = TokenShardDataset(_tokens(12 * 10), seq_len=9)  # 12 windows
+    cfg = _pipe_cfg(prefetch=False)
+    pipe = DataPipe(ds, cfg, global_rows=5)
+    batches = _drain(pipe, 5)
+    # 12 windows / 5 rows: 2 batches per epoch, ragged 2-window tail
+    # dropped, so batch 3 starts epoch 1 with a fresh permutation
+    assert pipe.state.epoch == 2 and pipe.state.cursor == 5
+    assert all(b.shape == (5, 10) for b in batches)
+    pipe2 = DataPipe(ds, cfg, global_rows=5)
+    for a, b in zip(batches, _drain(pipe2, 5)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_datapipe_prefetch_stream_matches_sync_stream():
+    ds = TokenShardDataset(_tokens(40 * 17), seq_len=16)
+    sync_pipe = DataPipe(ds, _pipe_cfg(seq_len=16, prefetch=False),
+                         global_rows=8)
+    pre_pipe = DataPipe(ds, _pipe_cfg(seq_len=16, prefetch=True,
+                                      prefetch_depth=3), global_rows=8)
+    try:
+        for a, b in zip(_drain(sync_pipe, 12), _drain(pre_pipe, 12)):
+            np.testing.assert_array_equal(a, b)
+        assert pre_pipe.state == sync_pipe.state
+    finally:
+        pre_pipe.close()
+
+
+def test_datapipe_mid_epoch_state_restore_bit_identical():
+    ds = TokenShardDataset(_tokens(40 * 17), seq_len=16)
+    cfg = _pipe_cfg(seq_len=16, prefetch=True)
+    pipe = DataPipe(ds, cfg, global_rows=8)
+    try:
+        _drain(pipe, 3)  # mid-epoch: cursor 24 of 40
+        saved = pipe.state_dict()
+        expected = _drain(pipe, 4)  # crosses the epoch-1 boundary
+        fresh = DataPipe(ds, cfg, global_rows=8)
+        try:
+            _drain(fresh, 1)  # desync on purpose; restore must rewind
+            fresh.load_state_dict(saved)
+            assert fresh.state == DataState.from_dict(saved)
+            for a, b in zip(expected, _drain(fresh, 4)):
+                np.testing.assert_array_equal(a, b)
+        finally:
+            fresh.close()
+    finally:
+        pipe.close()
+
+
+def test_datapipe_restore_warns_on_fingerprint_mismatch():
+    import logging
+
+    ds = TokenShardDataset(_tokens(200), seq_len=9)  # 20 windows
+    pipe = DataPipe(ds, _pipe_cfg(prefetch=False), global_rows=4)
+    sd = pipe.state_dict()
+    # a different corpus (19 windows) cannot replay the saved stream
+    other = DataPipe(TokenShardDataset(_tokens(190), seq_len=9),
+                     _pipe_cfg(prefetch=False), global_rows=4)
+    records = []
+
+    class Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    ds_logger = logging.getLogger("DeeperSpeedTPU")  # propagate=False
+    handler = Capture(level=logging.WARNING)
+    ds_logger.addHandler(handler)
+    try:
+        other.load_state_dict(sd)
+    finally:
+        ds_logger.removeHandler(handler)
+    assert any("fingerprint" in m for m in records)
+
+
+def test_datapipe_restore_checkpoint_seed_wins_over_config():
+    ds = TokenShardDataset(_tokens(40 * 17), seq_len=16)
+    pipe = DataPipe(ds, _pipe_cfg(seq_len=16, seed=3, prefetch=False),
+                    global_rows=8)
+    _drain(pipe, 2)
+    sd = pipe.state_dict()
+    expected = _drain(pipe, 2)
+    # a restored run whose config names a DIFFERENT seed still replays
+    # the checkpoint's stream — the state seed wins, bit-identically
+    other = DataPipe(ds, _pipe_cfg(seq_len=16, seed=99, prefetch=False),
+                     global_rows=8)
+    other.load_state_dict(sd)
+    for a, b in zip(expected, _drain(other, 2)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_datapipe_packing_counts_documents():
+    docs = [np.full(5, i, np.int32) for i in range(30)]
+    cfg = _pipe_cfg(seq_len=9, pack_sequences=True, eos_id=49,
+                    prefetch=False, shuffle=False)
+    pipe = DataPipe(docs, cfg, global_rows=2)
+    batch, _ = pipe.next_global_batch()
+    # each 10-token row holds a 6-token doc (5 + eos) plus the start of
+    # the next: docs 0-2 land whole, doc 3's head fills the final slot
+    # (a batch-end partial still counts consumed — the cursor must
+    # strictly advance), so 4 documents are consumed across the 2 rows
+    assert batch["tokens"].shape == (2, 10)
+    assert pipe.state.cursor == 4 and pipe.state.samples == 4
+    assert batch["segment_ids"].max() >= 2
+
+
+def test_datapipe_rejects_oversized_batch_and_bad_build():
+    ds = TokenShardDataset(_tokens(40), seq_len=9)  # 4 windows
+    with pytest.raises(ValueError, match="exceeds the dataset"):
+        DataPipe(ds, _pipe_cfg(prefetch=False), global_rows=5)
+    with pytest.raises(ValueError, match='"source"'):
+        build_datapipe(_pipe_cfg(prefetch=False), dataset=None)
+
+
+def test_datapipe_curriculum_composes_with_bs_schedule():
+    ds = TokenShardDataset(_tokens(64 * 17), seq_len=16)
+    cfg = _pipe_cfg(seq_len=16, prefetch=False, curriculum={
+        "start_seq_len": 4, "warmup_steps": 20, "num_intervals": 2})
+    pipe = DataPipe(ds, cfg, global_rows=8, bs_schedule=[(0, 4), (20, 8)])
+    early, _ = pipe.next_global_batch()
+    assert early.shape == (8, 17)
+    assert (early[:4, 5:] == 0).all()  # seq warmup: 4+1 active columns
+    assert (early[4:] == 0).all()  # bs warmup: 4 active rows
+    for _ in range(25):
+        late, _ = pipe.next_global_batch()
+    assert (late != 0).any(axis=1).all()  # warmups over: all rows live
+
+
+# --------------------------------------------------------------------- #
+# engine integration (8-device CPU mesh)
+# --------------------------------------------------------------------- #
+
+
+def _token_loss(p, b):
+    import jax.numpy as jnp
+
+    x = b["tokens"] if isinstance(b, dict) else b
+    return jnp.mean((x.astype(jnp.float32) @ p["w"]) ** 2)
+
+
+def _engine_with_datapipe(source, tmp_path=None, **datapipe_overrides):
+    import jax.numpy as jnp
+    import deeperspeed_tpu as deepspeed
+
+    block = dict({"source": source, "seq_len": 16, "seed": 5},
+                 **datapipe_overrides)
+    cfg = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "datapipe": block,
+    }
+    params = {"w": jnp.zeros((17, 1), jnp.float32)}
+    engine, _, dl, _ = deepspeed.initialize(
+        model=_token_loss, model_parameters=params, config_params=cfg)
+    return engine, dl
+
+
+@pytest.fixture()
+def corpus_file(tmp_path):
+    path = str(tmp_path / "corpus.npy")
+    np.save(path, _tokens(64 * 17))
+    return path
+
+
+def test_engine_pulls_from_datapipe(corpus_file):
+    engine, dl = _engine_with_datapipe(corpus_file)
+    try:
+        assert engine.datapipe is not None and dl is None
+        l0 = float(engine.train_batch())
+        assert np.isfinite(l0)
+        for _ in range(3):
+            engine.train_batch()
+        assert engine.datapipe.state.step == 4
+        assert engine.datapipe.state.samples == 32
+    finally:
+        engine.datapipe.close()
+
+
+def test_engine_checkpoint_carries_datapipe_state(corpus_file, tmp_path):
+    engine, _ = _engine_with_datapipe(corpus_file)
+    try:
+        for _ in range(3):
+            engine.train_batch()
+        engine.save_checkpoint(str(tmp_path / "ckpt"))
+        expected = [engine.datapipe.next_global_batch()[0]
+                    for _ in range(3)]
+    finally:
+        engine.datapipe.close()
+
+    fresh, _ = _engine_with_datapipe(corpus_file)
+    try:
+        fresh.train_batch()  # desync on purpose; load must rewind
+        path, _ = fresh.load_checkpoint(str(tmp_path / "ckpt"))
+        assert path is not None
+        assert fresh.global_steps == 3
+        assert fresh.datapipe.state.step == 3
+        got = [fresh.datapipe.next_global_batch()[0] for _ in range(3)]
+        for a, b in zip(expected, got):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    finally:
+        fresh.datapipe.close()
+
+
+# --------------------------------------------------------------------- #
+# end-to-end: SIGKILL mid-epoch, resume consumes the identical
+# remaining batch stream (subprocess; element-wise on token ids)
+# --------------------------------------------------------------------- #
+
+_TRAINER = """\
+import hashlib
+import sys
+import numpy as np
+import jax.numpy as jnp
+import deeperspeed_tpu as deepspeed
+from deeperspeed_tpu.resilience import shutdown_resilience
+
+corpus, ckpt_dir, steps = sys.argv[1], sys.argv[2], int(sys.argv[3])
+
+def loss_fn(p, b):
+    return jnp.mean((b.astype(jnp.float32) @ p["w"]) ** 2)
+
+cfg = {
+    "train_batch_size": 8,
+    "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+    "datapipe": {"source": corpus, "seq_len": 16, "seed": 11,
+                 "prefetch": True, "prefetch_depth": 2,
+                 "stage_to_device": False},
+    "resilience": {"save_dir": ckpt_dir, "save_interval_steps": 2,
+                   "async_save": False, "preemption_guard": False},
+}
+params = {"w": jnp.zeros((17, 1), jnp.float32)}
+engine, _, _, _ = deepspeed.initialize(
+    model=loss_fn, model_parameters=params, config_params=cfg)
+path, _ = engine.load_checkpoint(ckpt_dir)
+start = engine.global_steps if path is not None else 0
+for i in range(start, steps):
+    batch, placed = engine.datapipe.next_global_batch()
+    toks = np.asarray(batch, np.int64)
+    print("STEP %d TOK %s" % (i, ",".join(map(str, toks.ravel()))),
+          flush=True)
+    engine.train_batch(batch=batch)
+engine.datapipe.close()
+shutdown_resilience()
+"""
+
+
+def _run_trainer(script, corpus, ckpt_dir, steps, faults=None):
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("XLA_FLAGS", None)  # single CPU device: faster startup
+    if faults is not None:
+        env["DS_TPU_FAULTS"] = faults
+    else:
+        env.pop("DS_TPU_FAULTS", None)
+    return subprocess.run(
+        [sys.executable, script, corpus, ckpt_dir, str(steps)],
+        env=env, capture_output=True, text=True, timeout=300)
+
+
+def _token_streams(stdout):
+    out = {}
+    for line in stdout.splitlines():
+        if line.startswith("STEP "):
+            head, toks = line.split(" TOK ")
+            out[int(head.split()[1])] = [int(t) for t in toks.split(",")]
+    return out
+
+
+def test_sigkill_mid_epoch_resumes_identical_token_stream(tmp_path):
+    script = str(tmp_path / "trainer.py")
+    with open(script, "w") as f:
+        f.write(_TRAINER)
+    corpus = str(tmp_path / "corpus.npy")
+    # 40 windows of 17 tokens; 6 steps x 8 rows = 48 > 40, so the run
+    # wraps into epoch 1 at the last step — the resume must replay both
+    # the mid-epoch remainder AND the epoch transition identically
+    np.save(corpus, _tokens(40 * 17))
+
+    # reference: uninterrupted 6 steps in its own checkpoint dir
+    ref = _run_trainer(script, corpus, str(tmp_path / "ref"), 6)
+    assert ref.returncode == 0, ref.stderr[-2000:]
+    ref_toks = _token_streams(ref.stdout)
+    assert sorted(ref_toks) == list(range(6))
+
+    # run 1: autosave every 2 steps; SIGKILL at step 5's boundary —
+    # mid-epoch 0 (cursor 40 of 40 pending wrap), after global_step4
+    # committed, with a prefetched batch sitting in the staging queue
+    ckpt = str(tmp_path / "ckpt")
+    killed = _run_trainer(script, corpus, ckpt, 6,
+                          faults='{"sigkill_at_step": 5}')
+    assert killed.returncode == -signal.SIGKILL, (
+        killed.returncode, killed.stdout, killed.stderr[-2000:])
+    from deeperspeed_tpu.checkpoint.serialization import read_latest
+    assert read_latest(ckpt) == "global_step4"
+
+    # run 2 (the supervisor restart): consumes the EXACT remaining
+    # batch sequence — asserted element-wise on the token ids
+    resumed = _run_trainer(script, corpus, ckpt, 6)
+    assert resumed.returncode == 0, resumed.stderr[-2000:]
+    res_toks = _token_streams(resumed.stdout)
+    assert sorted(res_toks) == [4, 5]
+    for i in (4, 5):
+        assert res_toks[i] == ref_toks[i], (
+            f"step {i}: resumed token stream diverged from the "
+            f"uninterrupted reference")
+
+
+@pytest.mark.slow
+def test_datapipe_bench_full(tmp_path):
+    """Full scripts/datapipe_bench.py run: prefetch must cut per-step
+    host-blocked time below 50% of the inline pipeline, the Chrome
+    trace must pass monitor.validate, and the datapipe_* metrics must
+    be registered."""
+    import json
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = str(tmp_path / "BENCH_datapipe.json")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)  # single CPU device: faster startup
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "scripts", "datapipe_bench.py"),
+         "--out", out],
+        capture_output=True, text=True, timeout=1200, env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    with open(out) as f:
+        report = json.load(f)
+    assert report["pass"]
+    assert report["stall_ratio"] < 0.5
+    assert report["trace"]["validate_rc"] == 0
+    assert report["trace"]["has_datapipe_wait_spans"]
+    assert report["metrics_registered"]
