@@ -7,11 +7,13 @@ in order, into fixed ``(rows, seq_len + 1)`` batches with an optional
 EOS separator and per-token segment ids, so short documents stop
 wasting the padded tail of every row. Packing is deterministic — same
 document stream, same packed batches — which keeps it compatible with
-the checkpointable cursor (the cursor counts documents consumed, and a
-resume replays the identical fill pattern).
+the checkpointable cursor: the cursor counts documents consumed
+completely, and a document split by a batch boundary is named by its
+``(cursor, tail offset)`` pair so the next batch resumes its remainder
+instead of dropping it. No token is ever lost to packing.
 """
 
-from typing import List, Optional, Tuple
+from typing import Iterable, Optional, Tuple
 
 import numpy as np
 
@@ -43,22 +45,36 @@ class SequencePacker:
                 [doc, np.array([self.eos_id], dtype=doc.dtype)])
         return doc
 
-    def pack(self, docs: List[np.ndarray],
-             rows: int) -> Tuple[np.ndarray, np.ndarray, int]:
-        """Pack ``docs`` into ``(tokens, segment_ids, docs_used)``.
+    def pack(self, docs: Iterable, rows: int,
+             first_offset: int = 0
+             ) -> Tuple[np.ndarray, np.ndarray, int, int]:
+        """Pack ``docs`` into ``(tokens, segment_ids, used, tail_offset)``.
 
-        Fills exactly ``rows`` rows of ``seq_len + 1`` tokens and
-        reports how many documents were consumed — the caller advances
-        its cursor by that count. Unconsumed documents are NOT buffered
-        (the cursor re-reads them next batch), so no hidden carry state
-        escapes the checkpoint.
+        ``docs`` may be any iterable — including a lazy generator over
+        the remaining epoch — and is consumed only until the ``rows``
+        rows of ``seq_len + 1`` tokens are full, so per-batch cost is
+        bounded by the batch size, never by the epoch remainder.
+
+        ``used`` counts documents consumed COMPLETELY; the caller
+        advances its cursor by that count. A document cut off by the end
+        of the batch is not counted — instead ``tail_offset`` reports
+        how far into its (EOS-augmented) token stream the batch reached,
+        and the caller stores it so the next batch resumes the remainder
+        via ``first_offset``. Unstarted documents are simply re-read
+        next batch. Either way no hidden carry state escapes the
+        checkpoint and no token is ever dropped.
         """
         tokens = np.full((rows, self.row_len), self.pad_id, self.dtype)
         segs = np.zeros((rows, self.row_len), np.int32)
         r, col, seg = 0, 0, 0
         used = 0
+        first = True
         for doc in docs:
             flat = self.doc_tokens(doc)
+            start = 0
+            if first:
+                start = min(int(first_offset), flat.size)
+                first = False
             if r >= rows:
                 break
             # a doc that cannot start in the remaining space of the
@@ -69,7 +85,7 @@ class SequencePacker:
                 if r >= rows:
                     break
             seg += 1
-            pos = 0
+            pos = start
             while pos < flat.size and r < rows:
                 space = self.row_len - col
                 take = min(space, flat.size - pos)
@@ -81,10 +97,8 @@ class SequencePacker:
                     r, col = r + 1, 0
                     seg = 1  # new row restarts segment numbering
             if pos < flat.size:
-                # ran out of rows mid-document: the partial copy stands
-                # (it filled the batch exactly); the doc still counts as
-                # consumed to keep the cursor strictly advancing
-                used += 1
-                break
+                # ran out of rows mid-document: hand the split point
+                # back so the next batch resumes this document at pos
+                return tokens, segs, used, pos
             used += 1
-        return tokens, segs, max(used, 1)
+        return tokens, segs, used, 0

@@ -83,22 +83,36 @@ class CurriculumStage:
             active_seq = min(seq_len, self.curriculum.seq_len_at(step))
         return active_rows, active_seq
 
-    def apply(self, tokens: np.ndarray, step: int) -> np.ndarray:
+    def apply(self, tokens: np.ndarray, step: int,
+              segment_ids: Optional[np.ndarray] = None):
         """Mask inactive rows/columns to pad_id, shape unchanged. Only
         plain 2-D token batches are maskable; anything else (tuple/dict
-        pytrees from user collate_fns) passes through untouched."""
-        if not self.active or not isinstance(tokens, np.ndarray) \
-                or tokens.ndim != 2:
-            return tokens
-        rows, width = tokens.shape
-        active_rows, active_seq = self.plan(step, rows, width - 1)
-        if active_rows >= rows and active_seq >= width - 1:
-            return tokens
+        pytrees from user collate_fns) passes through untouched.
+
+        When the batch is packed, pass its ``segment_ids`` too: every
+        position masked to pad_id also gets segment id 0, so the
+        attention/loss mask agrees that the padded tokens are not real
+        data. With ``segment_ids`` given the return is the
+        ``(tokens, segment_ids)`` pair."""
+        maskable = (self.active and isinstance(tokens, np.ndarray)
+                    and tokens.ndim == 2)
+        if maskable:
+            rows, width = tokens.shape
+            active_rows, active_seq = self.plan(step, rows, width - 1)
+            maskable = active_rows < rows or active_seq < width - 1
+        if not maskable:
+            return tokens if segment_ids is None else (tokens, segment_ids)
         out = np.array(tokens, copy=True)
+        segs = (np.array(segment_ids, copy=True)
+                if segment_ids is not None else None)
         if active_seq < width - 1:
             # width is seq_len + 1 (inputs + shifted targets): keep
             # active_seq + 1 tokens so the last target survives
             out[:, active_seq + 1:] = self.pad_id
+            if segs is not None:
+                segs[:, active_seq + 1:] = 0
         if active_rows < rows:
             out[active_rows:, :] = self.pad_id
-        return out
+            if segs is not None:
+                segs[active_rows:, :] = 0
+        return out if segs is None else (out, segs)

@@ -121,13 +121,14 @@ def epoch_order(seed: int, epoch: int, n: int,
 def order_fingerprint(seed: int, epoch: int, n: int,
                       shuffle: bool = True,
                       identity: Optional[dict] = None) -> str:
-    """Short stable hash of the epoch order (plus the dataset identity)
-    for the resume sanity check. Hashes a bounded prefix of the order so
-    fingerprinting stays O(1)-ish even for billion-window corpora."""
-    order = epoch_order(seed, epoch, n, shuffle=shuffle)
+    """Short stable hash naming the epoch order (plus the dataset
+    identity) for the resume sanity check. The order is a pure function
+    of ``(seed, epoch, n, shuffle)``, so hashing those parameters binds
+    the fingerprint to the order exactly — without materializing the
+    O(n) permutation, which matters on billion-window corpora."""
     h = hashlib.sha256()
-    h.update(f"{seed}:{epoch}:{n}:{int(shuffle)}:".encode())
-    h.update(order[:256].tobytes())
+    h.update(
+        f"{int(seed)}:{int(epoch)}:{int(n)}:{int(bool(shuffle))}".encode())
     if identity:
         h.update(repr(sorted(identity.items())).encode())
     return h.hexdigest()[:16]

@@ -127,14 +127,20 @@ class DataPipe:
             st = self._wrap_epoch(st)  # drop the ragged tail
         order = self._order_for(st.seed, st.epoch)
         if self.packer is not None:
-            docs = [self.dataset[int(i)] for i in order[st.cursor:]]
-            tokens, segs, used = self.packer.pack(docs, rows)
-            batch = {"tokens": self.stage.apply(tokens, st.step),
-                     "segment_ids": segs}
+            # lazy: the packer pulls only as many documents as the batch
+            # consumes, so per-batch cost is bounded by the batch size —
+            # never by the epoch remainder (which on a multi-TB corpus
+            # would mean O(n) reads per batch)
+            docs = (self.dataset[int(i)] for i in order[st.cursor:])
+            tokens, segs, used, offset = self.packer.pack(
+                docs, rows, first_offset=st.offset)
+            tokens, segs = self.stage.apply(tokens, st.step,
+                                            segment_ids=segs)
+            batch = {"tokens": tokens, "segment_ids": segs}
             next_st = DataState(
                 epoch=st.epoch, cursor=st.cursor + used, step=st.step + 1,
                 samples=st.samples + used, seed=st.seed,
-                fingerprint=st.fingerprint)
+                fingerprint=st.fingerprint, offset=offset)
             if next_st.cursor >= n:
                 next_st = self._wrap_epoch(next_st)
             return batch, next_st
@@ -234,7 +240,24 @@ class DataPipe:
                 st.fingerprint, expect)
         self.state = DataState(
             epoch=st.epoch, cursor=st.cursor, step=st.step,
-            samples=st.samples, seed=st.seed, fingerprint=expect)
+            samples=st.samples, seed=st.seed, fingerprint=expect,
+            offset=st.offset)
+        self._restart_production()
+
+    def seed_step(self, step: int) -> None:
+        """Align the curriculum/batch-size step with the engine's
+        ``global_steps`` when a restored checkpoint carries no datapipe
+        state (a pre-datapipe save). The batch stream still restarts
+        from epoch 0 — only the schedules stay consistent."""
+        self.state = DataState(
+            epoch=self.state.epoch, cursor=self.state.cursor,
+            step=int(step), samples=self.state.samples,
+            seed=self.state.seed, fingerprint=self.state.fingerprint,
+            offset=self.state.offset)
+        self._restart_production()
+
+    def _restart_production(self) -> None:
+        """Drop staged batches and re-produce from the current state."""
         if self._prefetcher is not None:
             self._prefetcher.close()
             self._start_prefetcher()

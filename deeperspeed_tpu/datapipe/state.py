@@ -1,11 +1,15 @@
 """DataState: the explicit, checkpointable iteration cursor.
 
 Everything needed to reproduce the remaining batch stream after a
-restart — including a mid-epoch SIGKILL — is five integers and a
+restart — including a mid-epoch SIGKILL — is six integers and a
 fingerprint:
 
   * ``epoch``      — which counter-based permutation is in effect;
   * ``cursor``     — samples already consumed from this epoch's order;
+  * ``offset``     — tokens already consumed from the (EOS-augmented)
+    document AT the cursor, when sequence packing split that document
+    at a batch boundary; 0 otherwise. The next packed batch resumes the
+    document there, so long documents lose nothing across batches;
   * ``step``       — global batches produced (drives the curriculum and
     the batch-size schedule composition, so prefetched batches are
     shaped for the step that will consume them);
@@ -34,11 +38,15 @@ class DataState:
     samples: int = 0
     seed: int = 0
     fingerprint: str = ""
+    offset: int = 0
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
 
     @classmethod
     def from_dict(cls, d: dict) -> "DataState":
+        # unknown keys are dropped and missing keys default, so
+        # checkpoints written before a field existed (e.g. ``offset``)
+        # restore cleanly
         known = {f.name for f in dataclasses.fields(cls)}
         return cls(**{k: v for k, v in (d or {}).items() if k in known})

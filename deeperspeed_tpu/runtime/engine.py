@@ -1576,8 +1576,19 @@ class Engine(ConfigAccessorsMixin):
             self.batch_size_scheduler.step(self.global_steps)
         self.global_samples = int(meta.get("global_samples", 0))
         self.micro_steps = int(meta.get("micro_steps", 0))
-        if self.datapipe is not None and meta.get("datapipe"):
-            self.datapipe.load_state_dict(meta["datapipe"])
+        if self.datapipe is not None:
+            if meta.get("datapipe"):
+                self.datapipe.load_state_dict(meta["datapipe"])
+            else:
+                logger.warning(
+                    "checkpoint %s carries no datapipe state (saved "
+                    "before the datapipe existed?): the input pipe "
+                    "restarts from epoch 0 and will NOT replay the "
+                    "original batch stream; seeding its curriculum step "
+                    "from global_steps=%d so the seq-len/batch-size "
+                    "schedules stay consistent", ck.ckpt_dir,
+                    self.global_steps)
+                self.datapipe.seed_step(self.global_steps)
         if (load_lr_scheduler_states and self.lr_scheduler is not None
                 and meta.get("lr_scheduler")):
             self.lr_scheduler.load_state_dict(meta["lr_scheduler"])
@@ -1702,8 +1713,19 @@ class Engine(ConfigAccessorsMixin):
             self.batch_size_scheduler.step(self.global_steps)
         self.global_samples = int(model_states.get("global_samples", 0))
         self.micro_steps = int(model_states.get("micro_steps", 0))
-        if self.datapipe is not None and model_states.get("datapipe"):
-            self.datapipe.load_state_dict(model_states["datapipe"])
+        if self.datapipe is not None:
+            if model_states.get("datapipe"):
+                self.datapipe.load_state_dict(model_states["datapipe"])
+            else:
+                logger.warning(
+                    "checkpoint %s carries no datapipe state (saved "
+                    "before the datapipe existed?): the input pipe "
+                    "restarts from epoch 0 and will NOT replay the "
+                    "original batch stream; seeding its curriculum step "
+                    "from global_steps=%d so the seq-len/batch-size "
+                    "schedules stay consistent", ck.ckpt_dir,
+                    self.global_steps)
+                self.datapipe.seed_step(self.global_steps)
         if (
             load_lr_scheduler_states
             and self.lr_scheduler is not None

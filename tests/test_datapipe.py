@@ -119,8 +119,11 @@ def test_data_state_round_trip_filters_unknown_keys():
 def test_sequence_packer_layout_and_segments():
     p = SequencePacker(seq_len=7, pad_id=-1, eos_id=9)  # rows of 8
     docs = [np.arange(3), np.arange(2), np.arange(20)]
-    tokens, segs, used = p.pack(docs, rows=2)
-    assert used == 3
+    tokens, segs, used, tail = p.pack(docs, rows=2)
+    # docs 0 and 1 land whole; doc2 is cut at the batch boundary, so it
+    # is NOT counted consumed — the tail offset names the split point
+    assert used == 2
+    assert tail == 9  # doc2's first 9 of 21 (20 + eos) tokens written
     # row 0: doc0 (0 1 2 9) then doc1 (0 1 9) then doc2's first token
     np.testing.assert_array_equal(tokens[0], [0, 1, 2, 9, 0, 1, 9, 0])
     np.testing.assert_array_equal(segs[0], [1, 1, 1, 1, 2, 2, 2, 3])
@@ -131,11 +134,45 @@ def test_sequence_packer_layout_and_segments():
 
 def test_sequence_packer_pads_when_docs_run_out():
     p = SequencePacker(seq_len=7, pad_id=0)
-    tokens, segs, used = p.pack([np.array([5, 5, 5])], rows=2)
-    assert used == 1
+    tokens, segs, used, tail = p.pack([np.array([5, 5, 5])], rows=2)
+    assert used == 1 and tail == 0
     np.testing.assert_array_equal(tokens[0], [5, 5, 5, 0, 0, 0, 0, 0])
     assert segs[0].tolist() == [1, 1, 1, 0, 0, 0, 0, 0]
     assert tokens[1].tolist() == [0] * 8 and segs[1].tolist() == [0] * 8
+
+
+def test_sequence_packer_resumes_split_doc_without_token_loss():
+    # one 30-token doc across rows of 8: each batch consumes 8 tokens
+    # and hands back the split point; resuming with first_offset must
+    # reproduce the document exactly, with nothing dropped
+    p = SequencePacker(seq_len=7, pad_id=-1)
+    doc = np.arange(30)
+    got, offset = [], 0
+    for _ in range(4):
+        tokens, segs, used, offset = p.pack([doc], rows=1,
+                                            first_offset=offset)
+        got.append(tokens[0][segs[0] > 0])
+        if used:
+            break
+    assert used == 1 and offset == 0
+    np.testing.assert_array_equal(np.concatenate(got), doc)
+
+
+def test_sequence_packer_consumes_iterable_lazily():
+    # the packer must stop pulling documents once the batch is full —
+    # feeding it the whole remaining epoch may not materialize it
+    p = SequencePacker(seq_len=7, pad_id=0)
+    fetched = []
+
+    def stream():
+        for i in range(10_000):
+            fetched.append(i)
+            yield np.full(8, i + 1, np.int32)
+
+    tokens, segs, used, tail = p.pack(stream(), rows=2)
+    assert used == 2 and tail == 0
+    # at most the consumed docs plus one look-ahead are ever fetched
+    assert len(fetched) <= 3
 
 
 def test_batch_size_at_reads_static_schedule():
@@ -172,6 +209,25 @@ def test_curriculum_stage_masks_without_reshaping():
     # non-2D / dict pytrees pass through untouched
     d = {"a": batch}
     assert stage.apply(d, step=0) is d
+
+
+def test_curriculum_stage_masks_segment_ids_with_tokens():
+    # segment_ids==0 is the attention/loss mask: every position the
+    # warmups pad out must also lose its segment id, or the model would
+    # attend to and train on the pad tokens as real data
+    cur = SeqLenCurriculum(final_seq_len=8, start_seq_len=4,
+                           warmup_steps=10, num_intervals=2)
+    stage = CurriculumStage(cur, bs_schedule=[(0, 2), (10, 4)], pad_id=0)
+    tokens = np.arange(1, 37).reshape(4, 9)
+    segs = np.ones((4, 9), np.int32)
+    out, osegs = stage.apply(tokens, step=0, segment_ids=segs)
+    assert (osegs == (out != 0)).all()  # masks agree everywhere
+    assert (osegs[:2, :5] == 1).all() and (osegs[:2, 5:] == 0).all()
+    assert (osegs[2:] == 0).all()
+    # warmups over: the pair passes through untouched
+    out2, osegs2 = stage.apply(tokens, step=50, segment_ids=segs)
+    np.testing.assert_array_equal(out2, tokens)
+    np.testing.assert_array_equal(osegs2, segs)
 
 
 # --------------------------------------------------------------------- #
@@ -321,19 +377,45 @@ def test_datapipe_restore_checkpoint_seed_wins_over_config():
         np.testing.assert_array_equal(a, b)
 
 
-def test_datapipe_packing_counts_documents():
+def test_datapipe_packing_counts_documents_and_resumes_tails():
     docs = [np.full(5, i, np.int32) for i in range(30)]
     cfg = _pipe_cfg(seq_len=9, pack_sequences=True, eos_id=49,
                     prefetch=False, shuffle=False)
     pipe = DataPipe(docs, cfg, global_rows=2)
     batch, _ = pipe.next_global_batch()
     # each 10-token row holds a 6-token doc (5 + eos) plus the start of
-    # the next: docs 0-2 land whole, doc 3's head fills the final slot
-    # (a batch-end partial still counts consumed — the cursor must
-    # strictly advance), so 4 documents are consumed across the 2 rows
+    # the next: docs 0-2 land whole; doc 3 is cut at the batch boundary,
+    # so the cursor stays on it and the state's offset names the split
     assert batch["tokens"].shape == (2, 10)
-    assert pipe.state.cursor == 4 and pipe.state.samples == 4
+    assert pipe.state.cursor == 3 and pipe.state.samples == 3
+    assert pipe.state.offset == 2  # doc 3's first 2 tokens written
     assert batch["segment_ids"].max() >= 2
+    # the next batch resumes doc 3's remainder (3 payload tokens + eos)
+    # instead of dropping it — its tail opens row 0 as segment 1
+    batch2, _ = pipe.next_global_batch()
+    np.testing.assert_array_equal(batch2["tokens"][0, :4], [3, 3, 3, 49])
+    assert batch2["segment_ids"][0, :4].tolist() == [1, 1, 1, 1]
+
+
+def test_datapipe_packed_stream_loses_no_tokens():
+    # drain several packed batches and rebuild the token stream from the
+    # non-pad positions: it must be a prefix of the concatenated corpus
+    docs = [np.arange(i + 1, dtype=np.int32) + 100 * i
+            for i in range(12)]  # ragged: 1..12 tokens each
+    cfg = _pipe_cfg(seq_len=4, pack_sequences=True, prefetch=False,
+                    shuffle=False)
+    pipe = DataPipe(docs, cfg, global_rows=2)
+    got = []
+    while pipe.state.epoch == 0:
+        batch, _ = pipe.next_global_batch()
+        toks, segs = batch["tokens"], batch["segment_ids"]
+        got.append(toks[segs > 0])
+    stream = np.concatenate(got)
+    expect = np.concatenate([np.asarray(d) for d in docs])
+    np.testing.assert_array_equal(stream, expect[:stream.size])
+    # every full document made it through — at most one ragged batch
+    # tail of the epoch's final document may be re-read next epoch
+    assert stream.size >= expect.size - cfg.seq_len - 1
 
 
 def test_datapipe_rejects_oversized_batch_and_bad_build():
@@ -342,6 +424,33 @@ def test_datapipe_rejects_oversized_batch_and_bad_build():
         DataPipe(ds, _pipe_cfg(prefetch=False), global_rows=5)
     with pytest.raises(ValueError, match='"source"'):
         build_datapipe(_pipe_cfg(prefetch=False), dataset=None)
+
+
+def test_datapipe_curriculum_masks_packed_segment_ids():
+    docs = [np.full(6, i + 1, np.int32) for i in range(40)]
+    cfg = _pipe_cfg(seq_len=9, pack_sequences=True, prefetch=False,
+                    shuffle=False, curriculum={
+                        "start_seq_len": 4, "warmup_steps": 20,
+                        "num_intervals": 2})
+    pipe = DataPipe(docs, cfg, global_rows=2)
+    batch, _ = pipe.next_global_batch()
+    toks, segs = batch["tokens"], batch["segment_ids"]
+    # seq warmup keeps 4+1 columns; the masked columns must read as
+    # padding in BOTH arrays, or they'd be attended/trained on
+    assert (toks[:, 5:] == 0).all() and (segs[:, 5:] == 0).all()
+    assert (segs[:, :5] > 0).all()
+
+
+def test_datapipe_seed_step_aligns_schedules_without_state():
+    ds = TokenShardDataset(_tokens(40 * 17), seq_len=16)
+    cfg = _pipe_cfg(seq_len=16, prefetch=False, curriculum={
+        "start_seq_len": 4, "warmup_steps": 20, "num_intervals": 2})
+    pipe = DataPipe(ds, cfg, global_rows=8)
+    pipe.seed_step(50)  # pre-datapipe checkpoint: engine seeds the step
+    assert pipe.state.step == 50 and pipe.state.cursor == 0
+    batch, _ = pipe.next_global_batch()
+    # warmup is over at step 50, so no curriculum masking applies
+    assert (batch != 0).any(axis=1).all()
 
 
 def test_datapipe_curriculum_composes_with_bs_schedule():
@@ -431,6 +540,53 @@ def test_engine_checkpoint_carries_datapipe_state(corpus_file, tmp_path):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     finally:
         fresh.datapipe.close()
+
+
+def test_engine_warns_and_seeds_step_on_pre_datapipe_checkpoint(
+        corpus_file, tmp_path):
+    """A checkpoint saved WITHOUT a datapipe restores into an engine
+    that has one: the load must warn (the batch stream cannot replay)
+    and seed the pipe's curriculum step from global_steps instead of
+    silently leaving it at 0."""
+    import logging
+
+    import jax.numpy as jnp
+    import deeperspeed_tpu as deepspeed
+
+    cfg = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+    }
+    params = {"w": jnp.zeros((17, 1), jnp.float32)}
+    engine, _, _, _ = deepspeed.initialize(
+        model=_token_loss, model_parameters=params, config_params=cfg)
+    batch = _tokens(8 * 17).reshape(8, 17).astype(np.int32)
+    for _ in range(3):
+        engine.train_batch(batch=batch)
+    engine.save_checkpoint(str(tmp_path / "ckpt"))
+
+    fresh, _ = _engine_with_datapipe(corpus_file)
+    records = []
+
+    class Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    ds_logger = logging.getLogger("DeeperSpeedTPU")
+    handler = Capture(level=logging.WARNING)
+    ds_logger.addHandler(handler)
+    try:
+        path, _ = fresh.load_checkpoint(str(tmp_path / "ckpt"))
+    finally:
+        ds_logger.removeHandler(handler)
+        fresh.datapipe.close()
+    assert path is not None
+    assert fresh.global_steps == 3
+    assert any("no datapipe state" in m for m in records)
+    # schedules stay aligned with the restored step; the stream restarts
+    assert fresh.datapipe.state.step == 3
+    assert fresh.datapipe.state.epoch == 0
+    assert fresh.datapipe.state.cursor == 0
 
 
 # --------------------------------------------------------------------- #
