@@ -1,4 +1,7 @@
+from .bucketing import Bucket, BucketPlan, build_plan
+from .config import CommConfig
 from .onebit import OnebitAdam, OnebitLamb
+from .reducer import GradReducer
 from .compressed import (
     compress,
     decompress,

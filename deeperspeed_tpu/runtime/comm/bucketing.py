@@ -1,0 +1,112 @@
+"""Size-bounded gradient bucketing in layer order.
+
+T3-style fine-grained reduction needs the grad pytree flattened into
+buckets small enough that an early bucket's collective can launch while
+later layers are still in backward. A :class:`BucketPlan` is built once
+from the parameter tree's shapes (host side, hashable, static under jit);
+``pack``/``unpack`` are traced helpers that move between the per-leaf tree
+view and the flat per-bucket view.
+
+Leaves fill buckets greedily in tree-flatten (layer) order and never
+split: a leaf larger than ``bucket_bytes`` gets a bucket of its own. Each
+bucket's flat length is padded up to a multiple of ``pad_to`` (the reducer
+passes ``world * block``) so quantized wire formats see whole blocks and
+whole per-device chunks without per-mode reshuffling.
+"""
+
+import dataclasses
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class Bucket:
+    index: int
+    leaf_ids: Tuple[int, ...]     # indices into the flat leaf list
+    shapes: Tuple[Tuple[int, ...], ...]
+    offsets: Tuple[int, ...]      # start of each leaf in the flat bucket
+    length: int                   # unpadded element count
+    padded: int                   # length rounded up to pad_to
+
+    @property
+    def pad(self) -> int:
+        return self.padded - self.length
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketPlan:
+    buckets: Tuple[Bucket, ...]
+    n_leaves: int
+    total_elements: int
+    pad_to: int
+
+    def fingerprint(self) -> Tuple:
+        """Static identity of the layout — compared on checkpoint restore
+        so residuals from a different plan are dropped, not misapplied."""
+        return tuple(
+            (b.leaf_ids, b.shapes, b.padded) for b in self.buckets)
+
+
+def build_plan(tree, bucket_bytes: int, pad_to: int = 1) -> BucketPlan:
+    """Plan buckets from a pytree of arrays (or ShapeDtypeStructs).
+
+    Bucket fill is measured in fp32 bytes of the flat view (4 bytes per
+    element) regardless of the leaves' storage dtype, because the reducer
+    packs buckets in fp32 before hitting the wire format.
+    """
+    leaves = jax.tree.leaves(tree)
+    if not leaves:
+        raise ValueError("cannot build a bucket plan from an empty tree")
+    cap = max(1, int(bucket_bytes) // 4)  # elements per bucket
+    buckets: List[Bucket] = []
+    ids: List[int] = []
+    shapes: List[Tuple[int, ...]] = []
+    offsets: List[int] = []
+    fill = 0
+
+    def flush():
+        nonlocal ids, shapes, offsets, fill
+        if not ids:
+            return
+        padded = -(-fill // pad_to) * pad_to
+        buckets.append(Bucket(
+            index=len(buckets), leaf_ids=tuple(ids), shapes=tuple(shapes),
+            offsets=tuple(offsets), length=fill, padded=padded))
+        ids, shapes, offsets, fill = [], [], [], 0
+
+    for i, leaf in enumerate(leaves):
+        size = 1
+        for d in leaf.shape:
+            size *= int(d)
+        if ids and fill + size > cap:
+            flush()
+        ids.append(i)
+        shapes.append(tuple(int(d) for d in leaf.shape))
+        offsets.append(fill)
+        fill += size
+    flush()
+    return BucketPlan(
+        buckets=tuple(buckets), n_leaves=len(leaves),
+        total_elements=sum(b.length for b in buckets), pad_to=pad_to)
+
+
+def pack(bucket: Bucket, leaves: Sequence[jax.Array]) -> jax.Array:
+    """Concatenate a bucket's leaves into its flat fp32 (padded,) view."""
+    parts = [leaf.reshape(-1).astype(jnp.float32) for leaf in leaves]
+    if bucket.pad:
+        parts.append(jnp.zeros((bucket.pad,), jnp.float32))
+    return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+
+
+def unpack(bucket: Bucket, flat: jax.Array) -> List[jax.Array]:
+    """Split a flat (padded,) view back into the bucket's fp32 leaves."""
+    out = []
+    for shape, off in zip(bucket.shapes, bucket.offsets):
+        size = 1
+        for d in shape:
+            size *= d
+        out.append(jax.lax.dynamic_slice_in_dim(flat, off, size)
+                   .reshape(shape))
+    return out

@@ -1,0 +1,474 @@
+"""GradReducer — bucketed, quantized gradient collectives.
+
+The engine's default gradient sync is one monolithic XLA-scheduled
+all-reduce at the end of backward. This module replaces it (when the
+``"comm"`` config block is active) with explicit per-bucket collectives in
+the style of the reference's 1-bit/compressed allreduce work:
+
+* the grad tree flattens into size-bounded buckets in layer order
+  (:mod:`.bucketing`), so each bucket's collective depends only on its own
+  leaves and XLA can overlap early-bucket reduction with late-layer
+  backward compute (T3-style);
+* each bucket reduces under a pluggable wire format — ``fp32`` (plain
+  ring allreduce), ``bf16``, ``int8`` blockwise-quantized with per-block
+  scales (EQuARX-style two-phase all_to_all + all_gather), or the 24-bit
+  ``compressed`` block-exponent format from :mod:`.compressed`;
+* lossy modes carry persistent per-device **error-feedback** residuals:
+  the quantization error of step *t* is added back to the raw gradient at
+  step *t+1*, so the running sum of what hit the wire tracks the running
+  sum of true gradients and the loss curve follows fp32;
+* an optional **hierarchical** (ZeRO++ qgZ style) schedule for the int8
+  mode: intra-group reduce-scatter in full precision over the fast links,
+  then quantized all_gather across groups, then a quantized intra-group
+  rebuild — selected when the mesh spans multiple hosts.
+
+All collectives run inside ``shard_map`` over the data axis on per-device
+gradient shards (the engine computes *local* grads, see
+``Engine._batch_grads_local``); averaging over the axis reproduces the
+global-mean-gradient semantics of the implicit GSPMD reduction.
+"""
+
+import logging
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+try:
+    from jax import shard_map
+
+    _SHMAP_CHECK_KWARGS = {"check_vma": False}
+except ImportError:  # older jax: different module AND different kwarg name
+    from jax.experimental.shard_map import shard_map
+
+    _SHMAP_CHECK_KWARGS = {"check_rep": False}
+
+from ...monitor import trace_span
+from ...parallel.topology import DATA_AXIS
+from . import bucketing
+from .compressed import _compress_blocks, _decompress_blocks
+from .config import CommConfig
+
+logger = logging.getLogger(__name__)
+
+
+# --------------------------------------------------------------------------
+# blockwise int8 quantization (EQuARX-style per-block scales)
+# --------------------------------------------------------------------------
+
+
+def quantize_int8_blocks(x, block: int):
+    """(n,) fp32 (n divisible by block) -> ((nb, block) int8, (nb,) f32)."""
+    nb = x.shape[0] // block
+    xb = x.reshape(nb, block)
+    s = jnp.max(jnp.abs(xb), axis=1) / 127.0
+    s = jnp.where(s > 0, s, 1.0)  # all-zero block: scale 1 -> q == 0
+    q = jnp.clip(jnp.rint(xb / s[:, None]), -127, 127).astype(jnp.int8)
+    return q, s
+
+
+def dequantize_int8_blocks(q, s):
+    return (q.astype(jnp.float32) * s[:, None]).reshape(-1)
+
+
+class GradReducer:
+    """Bucketed gradient reduction over the data axis of a mesh.
+
+    Built once per engine from the parameter tree's shapes; owns the
+    :class:`~.bucketing.BucketPlan`, the per-bucket error-feedback
+    residual state (a list over buckets of dicts of ``(world, n)`` arrays
+    sharded ``P(data, None)``), and both execution styles:
+
+    * :meth:`reduce_stacked` — traced; called inside the engine's fused
+      ``train_batch`` jit on the whole stacked-local-grad tree.
+    * :meth:`reduce_dispatch` — imperative; one jitted dispatch per
+      bucket, each wrapped in a ``comm/reduce`` trace span, used by the
+      ``backward()/step()`` path where per-bucket launches are visible.
+    """
+
+    def __init__(self, config: CommConfig, mesh, *, axis_name: str = DATA_AXIS,
+                 registry=None):
+        self.cfg = config
+        self.mesh = mesh
+        self.axis = axis_name
+        self.world = int(mesh.shape[axis_name])
+        self.plan: Optional[bucketing.BucketPlan] = None
+        self.hier_k = self._resolve_hierarchy()
+        self._jit_cache: Dict = {}
+        self._c_buckets = self._c_wire = None
+        if registry is not None:
+            self._c_buckets = registry.counter(
+                "comm_buckets", "gradient buckets reduced")
+            self._c_wire = registry.counter(
+                "comm_wire_bytes", "modeled per-device bytes on the wire")
+
+    # ------------------------------------------------------------------ #
+    # setup
+    # ------------------------------------------------------------------ #
+
+    def _resolve_hierarchy(self) -> Optional[int]:
+        cfg = self.cfg
+        if cfg.hierarchical == "off":
+            return None
+        if cfg.hierarchical == "auto" and jax.process_count() <= 1:
+            return None
+        k = int(cfg.intra_size or jax.local_device_count())
+        if not (1 < k < self.world) or self.world % k:
+            logger.warning(
+                "comm: hierarchical schedule needs 1 < intra_size < world "
+                "with intra_size | world (got intra_size=%d, world=%d); "
+                "falling back to the flat schedule", k, self.world)
+            return None
+        if cfg.mode != "int8":
+            logger.warning(
+                'comm: hierarchical schedule applies to mode "int8" only '
+                '(got "%s"); using the flat schedule', cfg.mode)
+            return None
+        return k
+
+    def build_plan(self, tree) -> bucketing.BucketPlan:
+        """Plan buckets from the parameter/grad tree (arrays or structs)."""
+        pad_to = self.cfg.block * (self.world if self.world > 1 else 1)
+        if self.hier_k:
+            # chunks of both W and k must be whole blocks; k | W ensures
+            # W * block covers the intra split as well
+            pad_to = self.cfg.block * self.world
+        self.plan = bucketing.build_plan(tree, self.cfg.bucket_bytes, pad_to)
+        return self.plan
+
+    @property
+    def n_buckets(self) -> int:
+        return len(self.plan.buckets)
+
+    def _residual_shapes(self, b: bucketing.Bucket) -> Dict[str, int]:
+        """Per-device residual vector lengths for one bucket."""
+        L = b.padded
+        if self.world == 1 or self.cfg.mode == "fp32":
+            return {}
+        if self.cfg.mode in ("bf16", "compressed"):
+            return {"e": L}
+        if self.hier_k:  # int8 hierarchical: both phases act on L/k chunks
+            return {"e1": L // self.hier_k, "e2": L // self.hier_k}
+        return {"e": L, "e2": L // self.world}  # int8 flat two-phase
+
+    def init_state(self) -> List[Dict[str, jax.Array]]:
+        """Zero residuals, stacked (world, n) and sharded P(data, None)."""
+        sh = NamedSharding(self.mesh, P(self.axis, None))
+        state = []
+        for b in self.plan.buckets:
+            state.append({
+                k: jax.device_put(np.zeros((self.world, n), np.float32), sh)
+                for k, n in self._residual_shapes(b).items()})
+        return state
+
+    def state_shardings(self) -> List[Dict[str, NamedSharding]]:
+        sh = NamedSharding(self.mesh, P(self.axis, None))
+        return [{k: sh for k in self._residual_shapes(b)}
+                for b in self.plan.buckets]
+
+    def state_fingerprint(self) -> Tuple:
+        """Identity of (layout, mode, world) — residuals restored from a
+        checkpoint with a different fingerprint are dropped, not reused."""
+        return (self.cfg.mode, self.world, self.hier_k or 0, self.cfg.block,
+                self.plan.fingerprint())
+
+    # ------------------------------------------------------------------ #
+    # per-bucket wire formats (per-device views, traced inside shard_map)
+    # ------------------------------------------------------------------ #
+
+    def _reduce_flat(self, v, res):
+        """One bucket: local (L,) fp32 contribution -> mean over the axis.
+
+        Returns ``(mean, new_residuals)``; the mean is bit-identical on
+        every device (post all_gather/psum), so shard_map can emit it
+        replicated.
+        """
+        cfg, W, ax = self.cfg, self.world, self.axis
+        if W == 1:
+            return v, res
+        ef = cfg.error_feedback
+        if cfg.mode == "fp32":
+            return jax.lax.pmean(v, ax), res
+        if cfg.mode == "bf16":
+            c = v + res["e"] if ef else v
+            sent = c.astype(jnp.bfloat16)
+            out = jax.lax.psum(sent, ax).astype(jnp.float32) / W
+            return out, {"e": c - sent.astype(jnp.float32) if ef
+                         else res["e"]}
+        if cfg.mode == "compressed":
+            c = v + res["e"] if ef else v
+            m, e = _compress_blocks(c, cfg.block)
+            new_e = (c - _decompress_blocks(m, e, v.shape[0]) if ef
+                     else res["e"])
+            ms = jax.lax.all_gather(m, ax)  # (W, nb, block) f16
+            es = jax.lax.all_gather(e, ax)  # (W, nb) s8
+            vals = jax.vmap(
+                lambda mm, ee: _decompress_blocks(mm, ee, v.shape[0]))(ms, es)
+            return jnp.sum(vals, axis=0) / W, {"e": new_e}
+        if self.hier_k:
+            return self._reduce_int8_hier(v, res)
+        return self._reduce_int8_flat(v, res)
+
+    def _reduce_int8_flat(self, v, res):
+        """Two-phase int8: quantize -> all_to_all chunks -> exact partial
+        sums -> re-quantize -> all_gather.  ~2(L + 4L/block) wire bytes vs
+        8L for the fp32 ring — the EQuARX trade at 8 bits."""
+        cfg, W, ax, block = self.cfg, self.world, self.axis, self.cfg.block
+        ef = cfg.error_feedback
+        L = v.shape[0]
+        chunk = L // W
+        bpc = chunk // block  # blocks per chunk
+        c = v + res["e"] if ef else v
+        q, s = quantize_int8_blocks(c, block)
+        new_e = c - dequantize_int8_blocks(q, s) if ef else res["e"]
+        # ship chunk j of everyone's contribution to device j
+        rq = jax.lax.all_to_all(q.reshape(W, chunk), ax, 0, 0)   # (W, chunk)
+        rs = jax.lax.all_to_all(s.reshape(W, bpc), ax, 0, 0)     # (W, bpc)
+        vals = rq.astype(jnp.float32).reshape(W, bpc, block) * rs[:, :, None]
+        ssum = jnp.sum(vals, axis=0).reshape(-1)  # exact sum of my chunk
+        c2 = ssum + res["e2"] if ef else ssum
+        q2, s2 = quantize_int8_blocks(c2, block)
+        new_e2 = c2 - dequantize_int8_blocks(q2, s2) if ef else res["e2"]
+        aq = jax.lax.all_gather(q2, ax)  # (W, bpc, block)
+        as_ = jax.lax.all_gather(s2, ax)  # (W, bpc)
+        out = (aq.astype(jnp.float32) * as_[..., None]).reshape(-1) / W
+        return out, {"e": new_e, "e2": new_e2}
+
+    def _reduce_int8_hier(self, v, res):
+        """qgZ-style two-level schedule: intra-group reduce-scatter in full
+        precision (fast links), int8 all_gather across groups, then an int8
+        intra-group rebuild.  Both quantizations carry their own residual."""
+        cfg, W, ax, block = self.cfg, self.world, self.axis, self.cfg.block
+        ef = cfg.error_feedback
+        k, nn = self.hier_k, self.world // self.hier_k
+        intra = [[n * k + i for i in range(k)] for n in range(nn)]
+        inter = [[n * k + i for n in range(nn)] for i in range(k)]
+        chunk = jax.lax.psum_scatter(
+            v, ax, scatter_dimension=0, axis_index_groups=intra, tiled=True)
+        c1 = chunk + res["e1"] if ef else chunk
+        q, s = quantize_int8_blocks(c1, block)
+        new_e1 = c1 - dequantize_int8_blocks(q, s) if ef else res["e1"]
+        gq = jax.lax.all_gather(q, ax, axis_index_groups=inter)  # (nn,nb,blk)
+        gs = jax.lax.all_gather(s, ax, axis_index_groups=inter)  # (nn,nb)
+        gsum = jnp.sum(gq.astype(jnp.float32) * gs[..., None],
+                       axis=0).reshape(-1)  # global sum of my L/k chunk
+        c2 = gsum + res["e2"] if ef else gsum
+        q2, s2 = quantize_int8_blocks(c2, block)
+        new_e2 = c2 - dequantize_int8_blocks(q2, s2) if ef else res["e2"]
+        fq = jax.lax.all_gather(q2, ax, axis_index_groups=intra)  # (k,nb,blk)
+        fs = jax.lax.all_gather(s2, ax, axis_index_groups=intra)  # (k,nb)
+        out = (fq.astype(jnp.float32) * fs[..., None]).reshape(-1) / W
+        return out, {"e1": new_e1, "e2": new_e2}
+
+    # ------------------------------------------------------------------ #
+    # wire model (feeds the comm_wire_bytes counter; BENCH_comm.json uses
+    # the real compiled-HLO audit in profiling/hlo_bytes.py instead)
+    # ------------------------------------------------------------------ #
+
+    def bucket_wire_bytes(self, b: bucketing.Bucket) -> int:
+        """Modeled per-device bytes on the wire for one bucket, matching
+        the hlo_bytes wire_total convention (ring allreduce 2(W-1)/W x
+        result, gather/scatter/a2a (W-1)/W x result)."""
+        W = self.world
+        if W == 1:
+            return 0
+        f = (W - 1) / W
+        L = b.padded
+        nb = L // self.cfg.block
+        mode = self.cfg.mode
+        if mode == "fp32":
+            return int(2 * f * 4 * L)
+        if mode == "bf16":
+            return int(2 * f * 2 * L)
+        if mode == "compressed":  # all_gather of (W,nb,block) f16 + (W,nb) s8
+            return int(f * (2 * L * W + nb * W))
+        if self.hier_k:
+            k, nn = self.hier_k, W // self.hier_k
+            nb1 = (L // k) // self.cfg.block
+            return int(f * (4 * L // k            # intra RS f32
+                            + nn * (L // k) + 4 * nn * nb1   # inter AG int8
+                            + L + 4 * k * nb1))   # intra AG int8
+        return int(2 * f * (L + 4 * nb))  # int8 flat: a2a + AG, int8+scales
+
+    def total_wire_bytes(self) -> int:
+        return sum(self.bucket_wire_bytes(b) for b in self.plan.buckets)
+
+    def record_reduction_counters(self, count: int = 1) -> None:
+        """Host-side counter bump for reductions that ran inside a fused
+        jit (where per-bucket increments can't be observed)."""
+        if self._c_buckets is not None:
+            self._c_buckets.inc(self.n_buckets * count)
+            self._c_wire.inc(self.total_wire_bytes() * count)
+
+    # ------------------------------------------------------------------ #
+    # traced whole-tree reduction (fused train_batch path)
+    # ------------------------------------------------------------------ #
+
+    def _strip(self, res):  # (1, n) local views -> (n,)
+        return {k: a[0] for k, a in res.items()}
+
+    def _lift(self, res):  # (n,) -> (1, n) so out_specs P(data, None) fits
+        return {k: a[None] for k, a in res.items()}
+
+    def _leaf_spec(self, shape) -> P:
+        return P(self.axis, *([None] * len(shape)))
+
+    def reduce_stacked(self, stacked_tree, state):
+        """Reduce a tree of stacked local grads ((world, *shape) leaves,
+        sharded over the data axis) to the tree of global means.
+
+        Traceable — called inside the engine's fused train-step jit.
+        Returns ``(mean_tree, new_state)``.
+        """
+        leaves, treedef = jax.tree.flatten(stacked_tree)
+        if len(leaves) != self.plan.n_leaves:
+            raise ValueError(
+                f"grad tree has {len(leaves)} leaves but the bucket plan "
+                f"was built for {self.plan.n_leaves}")
+
+        def body(stacked, res_state):
+            outs = [None] * self.plan.n_leaves
+            new_state = []
+            for b, rb in zip(self.plan.buckets, res_state):
+                flat = bucketing.pack(b, [stacked[i][0] for i in b.leaf_ids])
+                red, nr = self._reduce_flat(flat, self._strip(rb))
+                for i, leaf in zip(b.leaf_ids, bucketing.unpack(b, red)):
+                    outs[i] = leaf
+                new_state.append(self._lift(nr))
+            return outs, new_state
+
+        in_specs = ([self._leaf_spec(l.shape[1:]) for l in leaves],
+                    jax.tree.map(lambda _: P(self.axis, None), state))
+        out_specs = ([P() for _ in leaves],
+                     jax.tree.map(lambda _: P(self.axis, None), state))
+        fn = shard_map(body, mesh=self.mesh, in_specs=in_specs,
+                       out_specs=out_specs, **_SHMAP_CHECK_KWARGS)
+        outs, new_state = fn(leaves, state)
+        return jax.tree.unflatten(treedef, outs), new_state
+
+    # ------------------------------------------------------------------ #
+    # imperative per-bucket dispatch (backward()/step() path)
+    # ------------------------------------------------------------------ #
+
+    def _bucket_reduce_fn(self, j: int):
+        key = ("reduce", j)
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            b = self.plan.buckets[j]
+
+            def body(stacked, res_b):
+                flat = bucketing.pack(b, [s[0] for s in stacked])
+                red, nr = self._reduce_flat(flat, self._strip(res_b))
+                return bucketing.unpack(b, red), self._lift(nr)
+
+            res_spec = {k: P(self.axis, None)
+                        for k in self._residual_shapes(b)}
+            in_specs = ([self._leaf_spec(shape) for shape in b.shapes],
+                        res_spec)
+            out_specs = ([P() for _ in b.shapes], res_spec)
+            fn = jax.jit(shard_map(body, mesh=self.mesh, in_specs=in_specs,
+                                   out_specs=out_specs,
+                                   **_SHMAP_CHECK_KWARGS))
+            self._jit_cache[key] = fn
+        return fn
+
+    def reduce_dispatch(self, stacked_tree, state):
+        """Reduce bucket by bucket with one jitted dispatch each, wrapping
+        every launch in a ``comm/reduce`` span and bumping the comm
+        counters.  Same math as :meth:`reduce_stacked`."""
+        leaves, treedef = jax.tree.flatten(stacked_tree)
+        if len(leaves) != self.plan.n_leaves:
+            raise ValueError(
+                f"grad tree has {len(leaves)} leaves but the bucket plan "
+                f"was built for {self.plan.n_leaves}")
+        outs = [None] * self.plan.n_leaves
+        new_state = []
+        for j, b in enumerate(self.plan.buckets):
+            fn = self._bucket_reduce_fn(j)
+            wire = self.bucket_wire_bytes(b)
+            with trace_span("comm/reduce", lane="comm", bucket=j,
+                            mode=self.cfg.mode, elements=b.length,
+                            wire_bytes=wire):
+                bucket_out, nr = fn([leaves[i] for i in b.leaf_ids],
+                                    state[j])
+                bucket_out = jax.block_until_ready(bucket_out)
+            for i, leaf in zip(b.leaf_ids, bucket_out):
+                outs[i] = leaf
+            new_state.append(nr)
+            if self._c_buckets is not None:
+                self._c_buckets.inc()
+                self._c_wire.inc(wire)
+        return jax.tree.unflatten(treedef, outs), new_state
+
+    # ------------------------------------------------------------------ #
+    # transform-only path (pipeline engine stage boundaries)
+    # ------------------------------------------------------------------ #
+
+    def _transform_flat(self, v, res):
+        """Wire-format transform without a collective: quantize ->
+        dequantize with error feedback.  The pipeline engine's per-stage
+        programs already data-parallel-reduce grads via GSPMD; routing the
+        stage-boundary grads through this models the bucket wire format
+        (and keeps EF dynamics) where the reducer owns no collective."""
+        cfg = self.cfg
+        ef = cfg.error_feedback
+        if cfg.mode == "fp32":
+            return v, res
+        c = v + res["e"] if ef else v
+        if cfg.mode == "bf16":
+            out = c.astype(jnp.bfloat16).astype(jnp.float32)
+        elif cfg.mode == "compressed":
+            m, e = _compress_blocks(c, cfg.block)
+            out = _decompress_blocks(m, e, v.shape[0])
+        else:  # int8
+            q, s = quantize_int8_blocks(c, cfg.block)
+            out = dequantize_int8_blocks(q, s)
+        return out, {"e": c - out if ef else res["e"]}
+
+    def _transform_residual_shapes(self, b: bucketing.Bucket):
+        if self.cfg.mode == "fp32":
+            return {}
+        return {"e": b.padded}
+
+    def init_transform_state(self) -> List[Dict[str, jax.Array]]:
+        """Unstacked residuals for the transform-only path."""
+        return [{k: jnp.zeros((n,), jnp.float32)
+                 for k, n in self._transform_residual_shapes(b).items()}
+                for b in self.plan.buckets]
+
+    def transform_dispatch(self, tree, state):
+        """Apply the per-bucket wire-format transform to a full (already
+        reduced) grad tree; one jitted dispatch + span per bucket."""
+        leaves, treedef = jax.tree.flatten(tree)
+        if len(leaves) != self.plan.n_leaves:
+            raise ValueError(
+                f"grad tree has {len(leaves)} leaves but the bucket plan "
+                f"was built for {self.plan.n_leaves}")
+        outs = [None] * self.plan.n_leaves
+        new_state = []
+        for j, b in enumerate(self.plan.buckets):
+            key = ("transform", j)
+            fn = self._jit_cache.get(key)
+            if fn is None:
+                def make(b):
+                    def body(bucket_leaves, res_b):
+                        flat = bucketing.pack(b, bucket_leaves)
+                        out, nr = self._transform_flat(flat, res_b)
+                        return bucketing.unpack(b, out), nr
+                    return jax.jit(body)
+                fn = make(b)
+                self._jit_cache[key] = fn
+            with trace_span("comm/reduce", lane="comm", bucket=j,
+                            mode=self.cfg.mode, elements=b.length,
+                            transform_only=True):
+                bucket_out, nr = fn([leaves[i] for i in b.leaf_ids],
+                                    state[j])
+                bucket_out = jax.block_until_ready(bucket_out)
+            for i, leaf in zip(b.leaf_ids, bucket_out):
+                outs[i] = leaf
+            new_state.append(nr)
+            if self._c_buckets is not None:
+                self._c_buckets.inc()
+        return jax.tree.unflatten(treedef, outs), new_state

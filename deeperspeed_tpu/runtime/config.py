@@ -383,6 +383,34 @@ class TrainingConfig:
             except ValueError as e:
                 raise ConfigError(f'invalid "datapipe" block: {e}') from e
 
+        # ---- comm (bucketed / quantized gradient collectives) ----
+        # A "comm" block routes gradient reduction through the
+        # runtime/comm GradReducer: size-bounded layer-order buckets,
+        # fp32/bf16/int8/compressed wire formats with error-feedback
+        # residuals, optional hierarchical (qgZ) schedule. Validated
+        # eagerly like "serving"/"monitor".
+        self.comm_params = pd.get(c.COMM, None)
+        if self.comm_params is not None and not isinstance(
+                self.comm_params, dict):
+            raise ConfigError(
+                '"comm" must be a dict of CommConfig '
+                'overrides (or {"enabled": false})'
+            )
+        explicit_comm = (self.comm_params or {}).get(c.COMM_ENABLED)
+        self.comm_enabled = (
+            explicit_comm if explicit_comm is not None
+            else self.comm_params is not None
+        )
+        self._comm_config = None
+        if self.comm_enabled:
+            from .comm.config import CommConfig
+
+            try:
+                self._comm_config = CommConfig.from_dict(
+                    dict(self.comm_params, enabled=True))
+            except ValueError as e:
+                raise ConfigError(f'invalid "comm" block: {e}') from e
+
         # ---- fused Pallas kernels ----
         # A "kernels" block selects the fused elementwise/optimizer/
         # super-tile attention kernels (ops/kernel_config.py): mode
@@ -435,6 +463,11 @@ class TrainingConfig:
         """The "datapipe" block as a DataPipeConfig (None when absent
         or disabled); validated at parse time like "serving"."""
         return self._datapipe_config
+
+    def comm_config(self):
+        """The "comm" block as a CommConfig (None when absent or
+        disabled); validated at parse time like "serving"."""
+        return self._comm_config
 
     def get_sparse_attention(self, num_heads: int):
         """Build the configured SparsityConfig (reference runtime/config.py:213

@@ -265,3 +265,15 @@ RESILIENCE_ENABLED_DEFAULT = False
 DATAPIPE = "datapipe"
 DATAPIPE_ENABLED = "enabled"
 DATAPIPE_ENABLED_DEFAULT = False
+
+#############################################
+# Gradient collectives (runtime/comm/ package): bucketed, quantized,
+# overlap-scheduled reduction — GradReducer with fp32/bf16/int8/
+# compressed wire formats, error-feedback residuals, hierarchical
+# (qgZ) two-level schedule. Keys are validated by
+# runtime.comm.config.CommConfig.from_dict; block presence enables
+# unless {"enabled": false}.
+#############################################
+COMM = "comm"
+COMM_ENABLED = "enabled"
+COMM_ENABLED_DEFAULT = False

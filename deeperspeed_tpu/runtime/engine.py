@@ -286,6 +286,42 @@ class Engine(ConfigAccessorsMixin):
 
         self.state = self._init_state(params)
 
+        # comm (runtime/comm/ package): a "comm" config block swaps the
+        # monolithic XLA-scheduled grad all-reduce for the bucketed
+        # GradReducer — explicit per-bucket collectives over the data
+        # axis with quantized wire formats; error-feedback residuals live
+        # in _comm_state (outside EngineState, threaded through the fused
+        # step and checkpointed alongside the optimizer state)
+        self.comm = None
+        self._comm_state = None
+        self._comm_acc_reduced = None  # per-cycle backward() routing flag
+        if config.comm_config() is not None:
+            reasons = []
+            if self.zero_stage >= 2:
+                reasons.append(
+                    "zero stage >= 2 already reduce-scatters grads via "
+                    "the grad sharding specs")
+            if getattr(self, "_offload_cfg", None) is not None:
+                reasons.append("optimizer offload owns the grad path")
+            extra = [a for a, s in self.mesh.shape.items()
+                     if a != DATA_AXIS and int(s) > 1]
+            if extra:
+                reasons.append(f"mesh has non-data axes {extra} (the "
+                               "reducer is data-parallel only)")
+            if reasons:
+                logger.warning(
+                    "comm block ignored (keeping the monolithic XLA "
+                    "reduction): %s", "; ".join(reasons))
+            else:
+                from .comm.reducer import GradReducer
+
+                self.comm = GradReducer(
+                    config.comm_config(), self.mesh,
+                    registry=(self.monitor.registry
+                              if self.monitor is not None else None))
+                self.comm.build_plan(params)
+                self._comm_state = self.comm.init_state()
+
         # datapipe (datapipe/ package): a "datapipe" config block swaps
         # the sync dataloader pull for the streaming/prefetching host
         # pipeline — memory-mapped shards or initialize(training_data=),
@@ -583,9 +619,20 @@ class Engine(ConfigAccessorsMixin):
         return self._compiled[name]
 
     def _forward_grad_fn(self):
-        """jitted (state, batch, rng) -> (loss, grads) for ONE microbatch."""
+        """jitted (state, batch, rng) -> (loss, grads) for ONE microbatch.
+
+        Under comm the grads come back as the LOCAL per-device stack
+        ((world, *shape), sharded P(data)) with no collective in the
+        program — backward()/step() decide when the reducer runs."""
 
         def build():
+            if self.comm is not None:
+                def comm_fn(state, batch, rng):
+                    rng = self._fold_rng(rng)
+                    return self._batch_grads_local(state, batch, rng, 1)
+
+                return jax.jit(comm_fn)
+
             def fn(state, batch, rng):
                 rng = self._fold_rng(rng)
                 loss, grads = self._micro_grads(
@@ -660,11 +707,95 @@ class Engine(ConfigAccessorsMixin):
         )
         return loss_sum / gas, grads
 
+    def _batch_grads_local(self, state, batch, rng, gas):
+        """Traced: per-device LOCAL grads over gas microbatches — no
+        implicit GSPMD reduction; the comm GradReducer owns the
+        collective. shard_map over the data axis computes each device's
+        grads of its local-mean loss and returns them stacked
+        ``(world, *shape)`` (sharded ``P(data)``); averaging the stack
+        over the axis reproduces the global-mean-gradient semantics of
+        :meth:`_batch_grads`. Returns (global mean loss, stacked grads)."""
+        from .comm.reducer import _SHMAP_CHECK_KWARGS, shard_map
+
+        scale = state.scaler.loss_scale
+        theta = None
+        if self._pld_active():
+            batch, theta = batch
+
+        def body(params, scale_, batch_, rng_):
+            def one(mb, key):
+                if theta is not None:
+                    mb = (mb, theta)
+                return self._micro_grads(params, mb, key, scale_)
+
+            if gas == 1:
+                loss, grads = one(batch_, rng_)
+            else:
+                def resh(x):
+                    return jnp.reshape(
+                        x, (gas, x.shape[0] // gas) + x.shape[1:])
+
+                batch_g = jax.tree.map(resh, batch_)
+                zero_g = jax.tree.map(
+                    lambda p: jnp.zeros(p.shape, self._grad_accum_dtype),
+                    params)
+
+                def mb_body(carry, mb):
+                    acc, loss_sum, i = carry
+                    mb_loss, grads = one(mb, jax.random.fold_in(rng_, i))
+                    acc = jax.tree.map(
+                        lambda a, g: a + g.astype(a.dtype), acc, grads)
+                    return (acc, loss_sum + mb_loss, i + 1), None
+
+                (grads, loss_sum, _), _ = jax.lax.scan(
+                    mb_body, (zero_g, jnp.float32(0.0), jnp.int32(0)),
+                    batch_g)
+                loss = loss_sum / gas
+            loss = jax.lax.pmean(loss, DATA_AXIS)
+            grads = jax.tree.map(
+                lambda g: g.astype(self._grad_dtype)[None], grads)
+            return loss, grads
+
+        dspec = P(DATA_AXIS)
+        in_specs = (
+            jax.tree.map(lambda _: P(), state.params),
+            P(),
+            jax.tree.map(lambda x: P() if jnp.ndim(x) == 0 else dspec,
+                         batch),
+            P(),
+        )
+        out_specs = (P(), jax.tree.map(lambda _: dspec, state.params))
+        fn = shard_map(body, mesh=self.mesh, in_specs=in_specs,
+                       out_specs=out_specs, **_SHMAP_CHECK_KWARGS)
+        return fn(state.params, scale, batch, rng)
+
     def _train_batch_fn(self):
         """Fully fused jitted step: scan over gas microbatches + update."""
 
         def build():
             gas = self.gradient_accumulation_steps()
+
+            if self.comm is not None:
+                # comm path: local grads via shard_map, explicit bucketed
+                # reduction, then the shared update body. The comm state
+                # (error-feedback residuals) threads through the jit with
+                # donation like the engine state.
+                def comm_fn(state, comm_state, batch, lr, rng):
+                    rng = self._fold_rng(rng)
+                    loss, local = self._batch_grads_local(
+                        state, batch, rng, gas)
+                    grads, new_comm = self.comm.reduce_stacked(
+                        local, comm_state)
+                    grads = jax.tree.map(
+                        lambda g: g.astype(self._grad_dtype), grads)
+                    grads = partition.constrain(
+                        grads, self.grad_specs, self.mesh)
+                    new_state, metrics = self._apply_update_body(
+                        state, grads, lr, gas)
+                    metrics["loss"] = loss
+                    return new_state, new_comm, metrics
+
+                return jax.jit(comm_fn, donate_argnums=(0, 1))
 
             def fn(state, batch, lr, rng):
                 rng = self._fold_rng(rng)
@@ -954,14 +1085,37 @@ class Engine(ConfigAccessorsMixin):
         return loss
 
     def backward(self, loss=None, allreduce_gradients=True):
-        """Bank the stashed grads (reference engine.py:1040). The collective
-        schedule is decided by XLA from the grad sharding constraints."""
+        """Bank the stashed grads (reference engine.py:1040).
+
+        Without a "comm" block the collective schedule is decided by XLA
+        from the grad sharding constraints (the grads arriving here are
+        already globally reduced, so ``allreduce_gradients`` has nothing
+        left to route and is accepted for API compatibility). With the
+        comm GradReducer active, the stashed grads are per-device LOCAL
+        stacks and the flag is honored: True reduces this microbatch's
+        bucket stack now (reference default), False banks the local sum
+        and defers the reduction to the accumulation boundary in
+        ``step()`` — one collective per cycle instead of one per
+        microbatch. The two routings may not be mixed within a cycle."""
         assert self._stashed is not None, "backward() requires a prior forward()"
         stashed_loss, grads = self._stashed
         self._last_micro_loss = stashed_loss  # for step()-path monitoring
         self._stashed = None
         with trace_span("engine/backward", lane="engine",
                         micro_step=self.micro_steps):
+            if self.comm is not None:
+                reduce_now = bool(allreduce_gradients)
+                if self._grad_acc is None:
+                    self._comm_acc_reduced = reduce_now
+                elif self._comm_acc_reduced != reduce_now:
+                    raise RuntimeError(
+                        "backward(allreduce_gradients=...) must not change "
+                        "within one accumulation cycle: the bank holds "
+                        + ("reduced" if self._comm_acc_reduced else "local")
+                        + " gradients")
+                if reduce_now:
+                    grads, self._comm_state = self.comm.reduce_dispatch(
+                        grads, self._comm_state)
             if self._grad_acc is None:
                 # bank the carry in the configured accumulation dtype (see
                 # grad_accum_dtype) so the imperative path matches
@@ -986,10 +1140,17 @@ class Engine(ConfigAccessorsMixin):
             self._timer_start(STEP_MICRO_TIMER)
         gas = self.gradient_accumulation_steps()
         if self._acc_count >= gas:
+            banked = self._grad_acc
+            if self.comm is not None and not self._comm_acc_reduced:
+                # deferred routing (backward(allreduce_gradients=False)):
+                # the bank holds the SUM of local grad stacks; one bucketed
+                # reduction at the boundary covers the whole cycle
+                banked, self._comm_state = self.comm.reduce_dispatch(
+                    banked, self._comm_state)
             # hand the optimizer grads in the storage dtype (the fused path
             # casts its scan carry back the same way)
             banked = jax.tree.map(
-                lambda g: g.astype(self._grad_dtype), self._grad_acc
+                lambda g: g.astype(self._grad_dtype), banked
             )
             with trace_span("engine/step", lane="engine",
                             step=self.global_steps):
@@ -1010,6 +1171,7 @@ class Engine(ConfigAccessorsMixin):
                 self._store_grads(banked)
             self._grad_acc = None
             self._acc_count = 0
+            self._comm_acc_reduced = None
             self._after_optimizer_step(metrics)
             if wall:
                 self.timers(STEP_MICRO_TIMER).stop(
@@ -1131,7 +1293,12 @@ class Engine(ConfigAccessorsMixin):
                 fn = self._train_batch_fn()
                 if wd is not None:
                     wd.watch("engine/train_step", fn)
-                new_state, metrics = fn(self.state, batch, lr, rng)
+                if self.comm is not None:
+                    new_state, self._comm_state, metrics = fn(
+                        self.state, self._comm_state, batch, lr, rng)
+                    self.comm.record_reduction_counters()
+                else:
+                    new_state, metrics = fn(self.state, batch, lr, rng)
                 self.state = new_state
         if wd is not None:
             # the train step must compile once (after sharding commits,
@@ -1347,10 +1514,48 @@ class Engine(ConfigAccessorsMixin):
         if self._offload is not None:
             # host/NVMe state is the source of truth under offload
             optim_states["offload"] = self._offload.state_dict()
+        if self.comm is not None:
+            # error-feedback residuals: quantized modes need them to
+            # resume bit-identically (a dropped residual replays the
+            # quantization error into the next update)
+            optim_states["comm"] = to_host(self._comm_state)
+            optim_states["comm_fingerprint"] = repr(
+                self.comm.state_fingerprint())
         return {
             model_state_filename(): model_states,
             optim_state_filename(): optim_states,
         }
+
+    def _restore_comm_state(self, host_state, fingerprint):
+        """Re-place checkpointed error-feedback residuals. Residuals from
+        a different bucket layout / mode / world size are useless (and
+        misapplying them corrupts gradients), so a fingerprint mismatch
+        keeps the fresh zeros instead."""
+        if host_state is None:
+            if any(True for _ in jax.tree.leaves(self._comm_state)):
+                logger.warning(
+                    "checkpoint carries no comm residuals: error feedback "
+                    "restarts from zero (one step of re-accumulated "
+                    "quantization error)")
+            return
+        if fingerprint != repr(self.comm.state_fingerprint()):
+            logger.warning(
+                "checkpointed comm residuals were saved under a different "
+                "bucket layout/mode/world (fingerprint mismatch): error "
+                "feedback restarts from zero")
+            return
+        try:
+            # msgpack round-trips the per-bucket list as an index-keyed dict
+            if isinstance(host_state, dict):
+                host_state = [host_state[k]
+                              for k in sorted(host_state, key=int)]
+            self._comm_state = jax.tree.map(
+                lambda x, s: jax.device_put(np.asarray(x, np.float32), s),
+                list(host_state), self.comm.state_shardings())
+        except Exception as e:
+            logger.warning(
+                "comm residual restore failed (%s): error feedback "
+                "restarts from zero", e)
 
     def save_checkpoint(self, save_dir, tag=None, client_state=None, save_latest=True):
         self._tb_write_pending()
@@ -1433,6 +1638,10 @@ class Engine(ConfigAccessorsMixin):
             # restore them WITHOUT reading the (2x bigger) Adam moments
             save_sharded_tree(ck.path(f"{SHARDED_STATE_DIR}/master"),
                               state.master)
+        if self.comm is not None and jax.tree.leaves(self._comm_state):
+            # error-feedback residuals, already sharded P(data, None)
+            save_sharded_tree(ck.path(f"{SHARDED_STATE_DIR}/comm"),
+                              {"buckets": self._comm_state})
         if jax.process_index() == 0:
             meta = {
                 "sharded_io": True,
@@ -1452,6 +1661,8 @@ class Engine(ConfigAccessorsMixin):
                 ),
                 "client_state": client_state or {},
             }
+            if self.comm is not None:
+                meta["comm_fingerprint"] = repr(self.comm.state_fingerprint())
             ck.save(model_state_filename(), meta)
             from ..checkpoint.zero_to_fp32 import write_recovery_stub
 
@@ -1560,6 +1771,24 @@ class Engine(ConfigAccessorsMixin):
                     state = state._replace(master=master)
                     master_restored = True
                 optim_restored = True
+        comm_dir = ck.path(f"{SHARDED_STATE_DIR}/comm")
+        if (self.comm is not None and not load_module_only
+                and load_optimizer_states and os.path.isdir(comm_dir)):
+            if meta.get("comm_fingerprint") == repr(
+                    self.comm.state_fingerprint()):
+                try:
+                    restored_comm = load_sharded_tree(
+                        comm_dir, {"buckets": self._comm_state})
+                    self._comm_state = restored_comm["buckets"]
+                except Exception as e:
+                    logger.warning(
+                        "sharded comm residual restore failed (%s): error "
+                        "feedback restarts from zero", e)
+            else:
+                logger.warning(
+                    "checkpointed comm residuals were saved under a "
+                    "different bucket layout/mode/world (fingerprint "
+                    "mismatch): error feedback restarts from zero")
         if state.master is not None and not master_restored:
             # no master came off disk (params-only load, or a checkpoint
             # saved without one): re-derive it from the restored params, or
@@ -1703,6 +1932,10 @@ class Engine(ConfigAccessorsMixin):
                 scaler=scaler,
                 step=jnp.asarray(optim_states["step"], jnp.int32),
             )
+            if self.comm is not None:
+                self._restore_comm_state(
+                    optim_states.get("comm"),
+                    optim_states.get("comm_fingerprint"))
 
         state = state._replace(
             skipped=jnp.asarray(model_states.get("skipped_steps", 0), jnp.int32)

@@ -35,7 +35,7 @@ from ...checkpoint.serialization import (
     to_host,
     write_latest,
 )
-from ...monitor import trace_span
+from ...monitor import get_monitor, trace_span
 from ...parallel.topology import DATA_AXIS, MODEL_AXIS, PIPE_AXIS
 from ...utils.logging import log_dist, logger
 from ...utils.timer import SynchronizedWallClockTimer, ThroughputTimer
@@ -172,6 +172,17 @@ class PipelineEngine(ConfigAccessorsMixin):
         # tensorboard monitor (same surface as Engine; reference
         # pipe engine inherits it from DeepSpeedEngine)
         self.summary_writer = make_summary_writer(config)
+
+        # comm wire format at the stage boundary: each stage program
+        # already data-parallel-reduces its grads under GSPMD, so the
+        # GradReducer owns no collective here. A "comm" block instead
+        # routes every stage's reduced grads through the per-bucket
+        # quantize/dequantize transform (with error feedback), so the
+        # quantized wire formats shape pipeline training — and emit the
+        # same comm/reduce spans — exactly as on the plain engine.
+        self._comm_cfg = config.comm_config()
+        self._comm_reducers: List[Any] = [None] * self.num_stages
+        self._comm_states: List[Any] = [None] * self.num_stages
 
         self._init_stage_state()
         self._jit_cache: Dict[Any, Callable] = {}
@@ -474,7 +485,34 @@ class PipelineEngine(ConfigAccessorsMixin):
         """Data-parallel gradient reduction. The stage programs run under
         GSPMD on the stage sub-mesh with replicated params and data-sharded
         batches, so XLA already psums parameter grads across the 'data'
-        axis — this instruction is the schedule-visible marker."""
+        axis — this instruction is the schedule-visible marker.
+
+        With a "comm" config block, the already-reduced stage grads are
+        additionally routed through the GradReducer's transform-only path:
+        the same size-bounded buckets and wire formats (bf16 / int8 /
+        compressed, with persistent error-feedback residuals) as the plain
+        engine, minus the collective GSPMD already issued. Tied grads have
+        been summed by ReduceTiedGrads before this runs; the transform is
+        deterministic, so sharing stages stay in lockstep."""
+        if self._comm_cfg is None:
+            return
+        from ..comm.reducer import GradReducer
+
+        mon = get_monitor()
+        for s in range(self.num_stages):
+            g = self.stage_grads[s]
+            if g is None:
+                continue
+            red = self._comm_reducers[s]
+            if red is None:
+                red = GradReducer(
+                    self._comm_cfg, self.stage_meshes[s],
+                    registry=(mon.registry if mon is not None else None))
+                red.build_plan(g)
+                self._comm_reducers[s] = red
+                self._comm_states[s] = red.init_transform_state()
+            self.stage_grads[s], self._comm_states[s] = red.transform_dispatch(
+                g, self._comm_states[s])
 
     def _stage_norm_view(self, g, stage_id: int):
         """The stage's grads with tied duplicates dropped: after

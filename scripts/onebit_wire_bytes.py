@@ -15,7 +15,9 @@ Scales: the default audits BOTH the tiny smoke model and GPT-125M
 buffers only stress the design at real model sizes).
 
 Usage: run under the cleaned 8-device env (see tests/conftest.py), or let
-it re-exec itself.
+it re-exec itself.  ``scripts/comm_bench.py --onebit`` (the gradient-side
+wire bench for the "comm" config block) delegates here to refresh
+ONEBIT_WIRE.json alongside BENCH_comm.json.
 """
 
 import json

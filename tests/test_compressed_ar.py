@@ -19,7 +19,12 @@ from deeperspeed_tpu.runtime.comm.compressed import (
     reconstruct,
 )
 
-shard_map = partial(jax.shard_map, check_vma=False)
+try:
+    shard_map = partial(jax.shard_map, check_vma=False)
+except AttributeError:  # older jax: experimental location, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _shmap
+
+    shard_map = partial(_shmap, check_rep=False)
 
 
 def _mesh():
@@ -183,3 +188,85 @@ def test_compressed_preserves_dtype():
     with mesh:
         out = run(jnp.asarray(data))
     assert out.dtype == jnp.bfloat16
+
+
+# ---------------------------------------------------------------------- #
+# edge cases: non-block-divisible lengths, zeros, bf16, single elements
+# ---------------------------------------------------------------------- #
+
+
+@pytest.mark.parametrize("n", [1, 100, 129, 8 * 128 + 3])
+def test_compressed_all_reduce_non_block_divisible(n):
+    """The collective must pad/crop correctly when the per-shard length is
+    not a multiple of the 128-element block."""
+    mesh = _mesh()
+    data = np.random.RandomState(n).randn(8, n).astype(np.float32)
+
+    @jax.jit
+    def run(x):
+        def body(x):
+            x = x.reshape(-1)
+            return compressed_all_reduce(x, "data"), jax.lax.psum(x, "data")
+
+        return shard_map(body, mesh=mesh, in_specs=P("data", None),
+                         out_specs=(P(None), P(None)))(x)
+
+    with mesh:
+        comp, exact = run(jnp.asarray(data))
+    assert comp.shape == (n,)
+    np.testing.assert_allclose(np.asarray(comp), np.asarray(exact),
+                               rtol=5e-3, atol=5e-3)
+
+
+def test_compress_all_zero_tensor():
+    """All-zero input: frexp(0) = (0, 0); the round trip must return exact
+    zeros with no NaN/inf from the block normalization."""
+    x = jnp.zeros(300, jnp.float32)
+    m, e, meta = compress(x)
+    out = np.asarray(decompress(m, e, meta))
+    assert out.shape == (300,)
+    np.testing.assert_array_equal(out, np.zeros(300, np.float32))
+
+
+def test_onebit_compress_all_zero_tensor():
+    """Zero gradient + zero error: the mean-|x| scale is 0, the quantized
+    output must be exact zeros (not NaN from 0/0) and the error stays 0."""
+    from deeperspeed_tpu.runtime.comm.compressed import (
+        _unpack_signs, onebit_compress)
+
+    x = jnp.zeros(64, jnp.float32)
+    packed, scale, err = onebit_compress(x, jnp.zeros_like(x))
+    recon = np.asarray(_unpack_signs(packed, 64) * scale)
+    assert np.isfinite(recon).all()
+    np.testing.assert_array_equal(recon, np.zeros(64, np.float32))
+    np.testing.assert_array_equal(np.asarray(err), np.zeros(64, np.float32))
+
+
+def test_compress_bf16_input_round_trip():
+    """bf16 inputs flow through the fp32 block compressor; the round trip
+    must be exact at bf16 resolution (bf16 -> fp32 is lossless, fp16
+    mantissas cover bf16's 8 bits)."""
+    x32 = np.random.RandomState(3).randn(257).astype(np.float32)
+    x = jnp.asarray(x32).astype(jnp.bfloat16)
+    m, e, meta = compress(x)
+    out = decompress(m, e, meta, dtype=jnp.bfloat16)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_array_equal(
+        np.asarray(out.astype(jnp.float32)),
+        np.asarray(x.astype(jnp.float32)))
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 7, 8, 9, 15])
+def test_pack_signs_odd_sizes_round_trip(n):
+    """Single-element and sub-byte lengths: the chunk-split bit layout
+    pads to whole bytes; unpack must crop back to exactly n signs."""
+    from deeperspeed_tpu.runtime.comm.compressed import (
+        _pack_signs, _unpack_signs)
+
+    x = np.random.RandomState(n).randn(n).astype(np.float32)
+    x[0] = 0.0  # sign convention: >= 0 packs as +1
+    packed, padded = _pack_signs(jnp.asarray(x))
+    assert packed.shape == ((n + 7) // 8,)
+    assert padded == n
+    signs = np.asarray(_unpack_signs(packed, n))
+    np.testing.assert_array_equal(signs, np.where(x >= 0, 1.0, -1.0))
