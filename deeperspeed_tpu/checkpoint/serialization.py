@@ -236,14 +236,84 @@ def sharded_tree_top_keys(path: str) -> Optional[set]:
     return tops
 
 
+SPECS_FILE = "SPECS.json"
+
+
+def _leaf_spec_entry(x) -> Dict:
+    """Logical identity of one saved array: global shape, dtype, and the
+    PartitionSpec it was sharded with (None axes -> null)."""
+    entry = {
+        "shape": [int(s) for s in getattr(x, "shape", ())],
+        "dtype": str(np.dtype(getattr(x, "dtype", np.float32))),
+    }
+    sharding = getattr(x, "sharding", None)
+    spec = getattr(sharding, "spec", None)
+    if spec is not None:
+        entry["spec"] = [
+            list(a) if isinstance(a, tuple) else a for a in spec
+        ]
+    return entry
+
+
+def write_sharded_specs(path: str, tree: Any):
+    """Write the SPECS.json sidecar next to an orbax tree: per-leaf global
+    shape + dtype + logical PartitionSpec, keyed by '/'-joined key path.
+    This is what makes a sharded_io checkpoint *mesh-shape-agnostic*: a
+    resume at a different world size can reason about each array's logical
+    layout without rebuilding the writer's mesh."""
+    import json
+
+    def keystr(kp) -> str:
+        parts = []
+        for k in kp:
+            for attr in ("key", "idx", "name"):
+                if hasattr(k, attr):
+                    parts.append(str(getattr(k, attr)))
+                    break
+            else:
+                parts.append(str(k))
+        return "/".join(parts)
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    specs = {keystr(kp): _leaf_spec_entry(x) for kp, x in flat}
+    tmp = os.path.join(path, SPECS_FILE + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(specs, f, indent=1, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, os.path.join(path, SPECS_FILE))
+
+
+def read_sharded_specs(path: str) -> Optional[Dict[str, Dict]]:
+    """Read the SPECS.json sidecar; None for pre-elastic checkpoints."""
+    import json
+
+    p = os.path.join(path, SPECS_FILE)
+    if not os.path.isfile(p):
+        return None
+    try:
+        with open(p) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
 def save_sharded_tree(path: str, tree: Any):
     """Write a device pytree with orbax: each process persists only its own
-    addressable shards, in parallel — no gather, no replication."""
+    addressable shards, in parallel — no gather, no replication. A
+    SPECS.json sidecar records each leaf's global shape/dtype/logical spec
+    for mesh-shape-agnostic (elastic) restores."""
     import orbax.checkpoint as ocp
 
     path = os.path.abspath(path)
     with ocp.StandardCheckpointer() as ckptr:
         ckptr.save(path, tree, force=True)
+    if jax.process_index() == 0:
+        try:
+            write_sharded_specs(path, tree)
+        except Exception as e:  # sidecar is advisory — never fail the save
+            logger.warning("could not write %s sidecar in %s: %s",
+                           SPECS_FILE, path, e)
 
 
 def load_sharded_tree(path: str, target: Any):
@@ -267,3 +337,52 @@ def load_sharded_tree(path: str, target: Any):
         if getattr(t, "sharding", None) is not None else r,
         restored, target,
     )
+
+
+def _abstract_tree_from_specs(specs: Dict[str, Dict]) -> Any:
+    """Rebuild an abstract restore target from a SPECS.json sidecar:
+    nested dicts/lists of ShapeDtypeStruct at the SAVED global shapes,
+    addressed to a live local device. All-digit key levels become lists
+    (matching how write_sharded_specs flattens list containers)."""
+    sharding = jax.sharding.SingleDeviceSharding(jax.local_devices()[0])
+    root: Dict = {}
+    for key, ent in specs.items():
+        parts = key.split("/")
+        node = root
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = jax.ShapeDtypeStruct(
+            tuple(ent["shape"]), np.dtype(ent["dtype"]), sharding=sharding)
+
+    def listify(node):
+        if isinstance(node, dict):
+            if node and all(k.isdigit() for k in node):
+                return [listify(node[k]) for k in sorted(node, key=int)]
+            return {k: listify(v) for k, v in node.items()}
+        return node
+
+    return listify(root)
+
+
+def load_sharded_tree_raw(path: str):
+    """Restore an orbax tree at its SAVED global shapes (no caller-side
+    target): the escape hatch for elastic restores where the checkpointed
+    shape is world-size-dependent and differs from the running topology —
+    the caller reshapes (resilience/reshard.py) and then places the
+    result. When the SPECS.json sidecar is present, the restore target is
+    rebuilt from it on a live local device, so this works even when the
+    device set changed since save (orbax refuses a targetless restore in
+    that case)."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    specs = read_sharded_specs(path)
+    with ocp.StandardCheckpointer() as ckptr:
+        if specs:
+            try:
+                return ckptr.restore(path, _abstract_tree_from_specs(specs))
+            except Exception as e:
+                logger.warning(
+                    "sidecar-targeted restore of %s failed (%s); retrying "
+                    "targetless", path, e)
+        return ckptr.restore(path)

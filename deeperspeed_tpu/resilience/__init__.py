@@ -35,6 +35,8 @@ from .faults import (
     FaultInjector,
     FaultPlan,
     InjectedFault,
+    PoolEvent,
+    SpotPoolSimulator,
     corrupt_file,
 )
 from .manifest import (
@@ -52,6 +54,12 @@ from .manifest import (
 )
 from .manager import ResilienceManager
 from .preemption import PreemptionGuard
+from .reshard import (
+    plans_reshardable,
+    remap_data_state,
+    reshard_comm_residuals,
+    reshard_transform_residuals,
+)
 from .supervisor import Supervisor, SupervisorPolicy, compute_backoff
 from .writer import AsyncCheckpointWriter, CheckpointWriteError
 
@@ -66,7 +74,9 @@ __all__ = [
     "InjectedFault",
     "MANIFEST_FILE",
     "PREEMPTION_EXIT_CODE_DEFAULT",
+    "PoolEvent",
     "PreemptionGuard",
+    "SpotPoolSimulator",
     "ResilienceConfig",
     "ResilienceManager",
     "STAGING_SUFFIX",
@@ -79,6 +89,10 @@ __all__ = [
     "get_resilience_manager",
     "init_resilience",
     "is_committed",
+    "plans_reshardable",
+    "remap_data_state",
+    "reshard_comm_residuals",
+    "reshard_transform_residuals",
     "resolve_load_tag",
     "shutdown_resilience",
     "tag_status",

@@ -32,7 +32,7 @@ import dataclasses
 import json
 import os
 import signal
-from typing import Optional
+from typing import List, Optional, Sequence
 
 from ..utils.logging import logger
 
@@ -199,3 +199,103 @@ class FaultInjector:
                 logger.warning("fault: %s-corrupted %s",
                                self.plan.corrupt_after_save, full)
                 return
+
+
+# ------------------------------------------------------------------- #
+# spot-pool simulation (elastic drills)
+# ------------------------------------------------------------------- #
+
+
+@dataclasses.dataclass(frozen=True)
+class PoolEvent:
+    """One spot-pool episode: the trainer is SIGKILLed at optimizer step
+    ``kill_at_step``, after which the surviving pool holds
+    ``pool_after`` devices (shrink OR grow — preempted capacity often
+    comes back bigger)."""
+
+    kill_at_step: int
+    pool_after: int
+
+    def __post_init__(self):
+        if int(self.kill_at_step) < 1:
+            raise ValueError(
+                f"kill_at_step must be >= 1, got {self.kill_at_step}")
+        if int(self.pool_after) < 1:
+            raise ValueError(
+                f"pool_after must be >= 1, got {self.pool_after}")
+
+
+class SpotPoolSimulator:
+    """Deterministic spot-pool driver for elastic fault drills.
+
+    Owns the pool file the supervisor's ``--pool-file`` flag re-reads
+    before every launch, and a fixed schedule of :class:`PoolEvent`
+    episodes. Drill flow per supervised launch:
+
+      1. ``child_faults()`` -> the ``DS_TPU_FAULTS`` dict arming the
+         child's injector with this episode's ``sigkill_at_step``
+         (None once the schedule is drained — the final child runs to
+         completion).
+      2. the child dies; the drill calls ``on_child_exit(rc)``, which
+         advances the schedule and rewrites the pool file with the
+         surviving device count, so the supervisor's next
+         ``_choose_world`` sees the new pool.
+
+    Everything is schedule-driven — no clocks, no probabilities — so a
+    drill replays bit-for-bit."""
+
+    def __init__(self, pool_file: str, initial_pool: int,
+                 events: Sequence[PoolEvent]):
+        self.pool_file = pool_file
+        self.events = list(events)
+        self.index = 0
+        self.transitions: List[dict] = []  # one record per fired episode
+        self._write_pool(int(initial_pool))
+
+    @property
+    def current_event(self) -> Optional[PoolEvent]:
+        return (self.events[self.index]
+                if self.index < len(self.events) else None)
+
+    def _write_pool(self, n: int) -> None:
+        parent = os.path.dirname(self.pool_file)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        tmp = self.pool_file + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(f"{n}\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.pool_file)
+
+    def read_pool(self) -> int:
+        with open(self.pool_file) as f:
+            return int(f.read().strip())
+
+    def child_faults(self) -> Optional[dict]:
+        """The DS_TPU_FAULTS plan for the current episode's child."""
+        ev = self.current_event
+        if ev is None:
+            return None
+        return {"sigkill_at_step": int(ev.kill_at_step)}
+
+    def on_child_exit(self, rc: int) -> Optional[PoolEvent]:
+        """Advance the schedule after a child death: rewrite the pool
+        file with the episode's surviving device count and record the
+        transition. A clean exit (rc == 0) never advances — the run
+        outlived the schedule."""
+        ev = self.current_event
+        if ev is None or rc == 0:
+            return None
+        self.index += 1
+        self._write_pool(int(ev.pool_after))
+        self.transitions.append({
+            "kill_at_step": int(ev.kill_at_step),
+            "pool_after": int(ev.pool_after),
+            "exit_code": int(rc),
+        })
+        logger.info(
+            "spot-pool: episode %d fired (kill@%d, exit %d); surviving "
+            "pool is %d device(s)", self.index, ev.kill_at_step, rc,
+            ev.pool_after)
+        return ev

@@ -25,7 +25,7 @@ import shutil
 import time
 from typing import Optional
 
-from ..monitor import get_monitor, trace_span
+from ..monitor import get_monitor, trace_instant, trace_span
 from ..utils.logging import log_dist, logger
 from .config import ResilienceConfig
 from .faults import FaultInjector, plan_from_config_and_env
@@ -59,6 +59,8 @@ class ResilienceManager:
         self._warned_multiprocess = False
         self._warned_no_save_dir = False
         self._closed = False
+        self._resumed_tag: Optional[str] = None  # protected from pruning
+        self._restart_noted = False
 
     # ------------------------------------------------------------------ #
     # telemetry helpers
@@ -198,14 +200,19 @@ class ResilienceManager:
     def _prune(self, save_dir: str, keep: int) -> None:
         """Retention: drop the oldest COMMITTED tags past ``keep``.
         Legacy/unknown directories are never touched, and neither is the
-        tag ``latest`` currently points at."""
+        tag ``latest`` points at, the tag this run resumed from (it may
+        be the only state that predates an in-flight experiment), nor
+        the newest committed tag (an async save racing the interval
+        autosave must never leave the directory empty of valid tags)."""
         from ..checkpoint.serialization import read_latest
 
-        protected = read_latest(save_dir)
         committed = [t for t in list_tags(save_dir)
                      if is_committed(os.path.join(save_dir, t))]
+        protected = {read_latest(save_dir), self._resumed_tag}
+        if committed:
+            protected.add(committed[0])  # newest committed
         for tag in committed[keep:]:
-            if tag == protected:
+            if tag in protected:
                 continue
             victim = os.path.join(save_dir, tag)
             logger.info("resilience: pruning old checkpoint %s "
@@ -292,14 +299,49 @@ class ResilienceManager:
 
     def note_resumed(self, tag) -> None:
         self._inc("resilience_resume_total", "checkpoint resumes")
+        self._resumed_tag = str(tag)
         step = tag_step(str(tag))
         log_dist(f"resilience: resumed from tag {tag}"
                  + (f" (step {step})" if step is not None else ""),
                  ranks=[0])
 
-    def note_fallback(self) -> None:
+    def note_fallback(self, skipped_tag: Optional[str] = None) -> None:
         self._inc("resilience_fallback_total",
                   "loads that fell back past an invalid tag")
+        if skipped_tag is not None:
+            self._inc("resilience_corrupt_tags",
+                      "checkpoint tags skipped as torn/corrupt at load")
+            trace_instant("resilience/corrupt_tag", lane="resilience",
+                          tag=str(skipped_tag))
+            logger.warning(
+                "resilience: skipped corrupt/torn checkpoint tag %r",
+                skipped_tag)
+
+    def note_restart_context(self) -> None:
+        """Child-side record of a supervisor restart: when the process
+        was (re)launched by the supervisor (DS_TPU_RESTART_COUNT > 0),
+        bump ``resilience_restarts`` and drop a trace instant carrying
+        the restart reason and the chosen elastic world size. Once per
+        process — engine re-inits in one process do not re-count."""
+        if self._restart_noted:
+            return
+        self._restart_noted = True
+        try:
+            count = int(os.environ.get("DS_TPU_RESTART_COUNT", "0"))
+        except ValueError:
+            count = 0
+        if count <= 0:
+            return
+        reason = os.environ.get("DS_TPU_RESTART_REASON", "unknown")
+        world = os.environ.get("DS_TPU_WORLD_SIZE")
+        self._inc("resilience_restarts",
+                  "supervisor restarts observed by this run")
+        trace_instant("resilience/restart", lane="resilience",
+                      count=count, reason=reason,
+                      world_size=int(world) if world else None)
+        log_dist(f"resilience: restart #{count} (reason: {reason}"
+                 + (f", world size {world}" if world else "") + ")",
+                 ranks=[0])
 
     def attach_serving(self, serving_engine) -> None:
         if serving_engine not in self.serving:

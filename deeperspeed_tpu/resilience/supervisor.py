@@ -40,6 +40,8 @@ backoff policy is unit-testable without subprocesses.
 import argparse
 import json
 import os
+import random
+import re
 import subprocess
 import sys
 import time
@@ -53,16 +55,27 @@ from .manifest import find_latest_valid_tag, tag_step
 RESUME_TAG_ENV = "DS_TPU_RESUME_TAG"
 RESUME_DIR_ENV = "DS_TPU_RESUME_DIR"
 RESTART_COUNT_ENV = "DS_TPU_RESTART_COUNT"
+RESTART_REASON_ENV = "DS_TPU_RESTART_REASON"
 ELASTIC_WORLD_SIZES_ENV = "DS_TPU_ELASTIC_WORLD_SIZES"
+WORLD_SIZE_ENV = "DS_TPU_WORLD_SIZE"
 
 
 def compute_backoff(failures: int, base: float, factor: float,
-                    cap: float) -> float:
+                    cap: float, jitter: float = 0.0,
+                    rand: Optional[Callable[[], float]] = None) -> float:
     """Delay before restart number ``failures`` (1-based): base *
-    factor^(failures-1), capped. Pure so the policy is testable."""
+    factor^(failures-1), capped. ``jitter`` adds a bounded random
+    fraction (delay * U[0, jitter]) so a fleet of supervisors killed by
+    the same pool event does not restart in lockstep; the jittered delay
+    still respects ``cap``. Pure (given ``rand``) so the policy is
+    testable; jitter defaults off."""
     if failures <= 0:
         return 0.0
-    return min(cap, base * factor ** (failures - 1))
+    delay = min(cap, base * factor ** (failures - 1))
+    if jitter > 0.0:
+        u = (rand or random.random)()
+        delay = min(cap, delay * (1.0 + jitter * u))
+    return delay
 
 
 @dataclass
@@ -71,10 +84,19 @@ class SupervisorPolicy:
     backoff_base: float = 1.0
     backoff_factor: float = 2.0
     backoff_max: float = 60.0
+    backoff_jitter: float = 0.0  # bounded fraction; see compute_backoff
     preempt_exit_code: int = PREEMPTION_EXIT_CODE_DEFAULT
     checkpoint_dir: Optional[str] = None
     elastic_config: Optional[str] = None
     verify_checksums: bool = True
+    # elastic fleet: a file holding the surviving pool's device count,
+    # re-read before every (re)start; the supervisor picks the largest
+    # admissible elastic world size that fits and exports it
+    pool_file: Optional[str] = None
+    restart_log: Optional[str] = None  # JSONL transition record
+    # drills: also export JAX_PLATFORMS=cpu + --xla_force_host_platform_
+    # device_count so the chosen world size becomes real CPU devices
+    simulate_cpu_devices: bool = False
 
 
 class Supervisor:
@@ -90,6 +112,8 @@ class Supervisor:
         self.restarts = 0  # total child launches minus one
         self.crashes = 0  # non-preemption failures (drives backoff/cap)
         self.history: List[int] = []  # child return codes, in order
+        self.world_history: List[Optional[int]] = []  # world per launch
+        self._last_reason: Optional[str] = None  # why the NEXT launch is one
 
     @staticmethod
     def _run_subprocess(cmd: List[str], env: dict) -> int:
@@ -100,11 +124,15 @@ class Supervisor:
     def _child_env(self) -> dict:
         env = dict(os.environ)
         env[RESTART_COUNT_ENV] = str(self.restarts)
+        if self._last_reason is not None:
+            env[RESTART_REASON_ENV] = self._last_reason
         pol = self.policy
+        resume_tag = None
         if pol.checkpoint_dir:
             tag = find_latest_valid_tag(
                 pol.checkpoint_dir, verify_checksums=pol.verify_checksums)
             if tag is not None:
+                resume_tag = tag
                 env[RESUME_TAG_ENV] = tag
                 env[RESUME_DIR_ENV] = pol.checkpoint_dir
                 step = tag_step(tag)
@@ -118,12 +146,74 @@ class Supervisor:
                     logger.warning(
                         "supervisor: no valid checkpoint in %s; the "
                         "restart begins from scratch", pol.checkpoint_dir)
+        sizes: List[int] = []
         if pol.elastic_config:
             sizes = self._elastic_world_sizes(pol.elastic_config)
             if sizes:
                 env[ELASTIC_WORLD_SIZES_ENV] = ",".join(map(str, sizes))
                 logger.info("supervisor: elastic world sizes %s", sizes)
+        world = self._choose_world(sizes)
+        self.world_history.append(world)
+        if world is not None:
+            env[WORLD_SIZE_ENV] = str(world)
+            if pol.simulate_cpu_devices:
+                env["JAX_PLATFORMS"] = "cpu"
+                flags = re.sub(
+                    r"--xla_force_host_platform_device_count=\d+", "",
+                    env.get("XLA_FLAGS", "")).strip()
+                env["XLA_FLAGS"] = (
+                    f"{flags} " if flags else ""
+                ) + f"--xla_force_host_platform_device_count={world}"
+        self._log_event({"event": "launch", "restart": self.restarts,
+                         "reason": self._last_reason or "initial",
+                         "world_size": world, "resume_tag": resume_tag})
         return env
+
+    def _choose_world(self, sizes: List[int]) -> Optional[int]:
+        """The largest admissible world size for the surviving pool:
+        re-reads ``pool_file`` (an integer device count) before every
+        launch, then picks ``max(s in sizes if s <= pool)``. Without a
+        pool file the topology is whatever the launcher provides and the
+        child self-selects via DS_TPU_ELASTIC_WORLD_SIZES."""
+        pol = self.policy
+        if pol.pool_file is None:
+            return None
+        try:
+            with open(pol.pool_file) as f:
+                pool = int(f.read().strip())
+        except (OSError, ValueError) as e:
+            logger.warning("supervisor: unreadable pool file %s (%s); "
+                           "leaving world size unset", pol.pool_file, e)
+            return None
+        admissible = [s for s in sizes if s <= pool]
+        if not admissible:
+            logger.error(
+                "supervisor: no admissible elastic world size fits the "
+                "surviving pool of %d (valid: %s); launching without "
+                "%s — the child will fail fast and the backoff retries "
+                "while the pool recovers", pool, sizes, WORLD_SIZE_ENV)
+            return None
+        world = max(admissible)
+        if pool != world:
+            logger.info(
+                "supervisor: pool of %d devices -> elastic world size %d",
+                pool, world)
+        return world
+
+    def _log_event(self, record: dict) -> None:
+        """Append one transition record to the restart JSONL log."""
+        if self.policy.restart_log is None:
+            return
+        record = {"ts": time.time(), **record}
+        try:
+            parent = os.path.dirname(self.policy.restart_log)
+            if parent:
+                os.makedirs(parent, exist_ok=True)
+            with open(self.policy.restart_log, "a") as f:
+                f.write(json.dumps(record) + "\n")
+        except OSError as e:  # advisory — never kill the run loop
+            logger.warning("supervisor: could not append to restart log "
+                           "%s: %s", self.policy.restart_log, e)
 
     @staticmethod
     def _elastic_world_sizes(config_path: str) -> List[int]:
@@ -148,28 +238,39 @@ class Supervisor:
             if rc == 0:
                 logger.info("supervisor: run finished cleanly after %d "
                             "restart(s)", self.restarts)
+                self._log_event({"event": "exit", "code": 0,
+                                 "reason": "done",
+                                 "restarts": self.restarts})
                 return 0
             preempted = rc == pol.preempt_exit_code
             if preempted:
                 delay = 0.0
+                self._last_reason = "preemption"
                 logger.warning(
                     "supervisor: child preempted (exit %d); restarting "
                     "immediately", rc)
             else:
                 self.crashes += 1
+                self._last_reason = "crash"
                 if self.crashes > pol.max_restarts:
                     logger.error(
                         "supervisor: giving up after %d crash(es) "
                         "(max_restarts=%d); last exit code %d",
                         self.crashes, pol.max_restarts, rc)
+                    self._log_event({"event": "exit", "code": rc,
+                                     "reason": "gave_up",
+                                     "crashes": self.crashes})
                     return rc
                 delay = compute_backoff(
                     self.crashes, pol.backoff_base, pol.backoff_factor,
-                    pol.backoff_max)
+                    pol.backoff_max, pol.backoff_jitter)
                 logger.warning(
                     "supervisor: child crashed (exit %d, crash %d/%d); "
                     "restarting in %.1fs", rc, self.crashes,
                     pol.max_restarts, delay)
+            self._log_event({"event": "exit", "code": rc,
+                             "reason": self._last_reason,
+                             "crashes": self.crashes, "delay": delay})
             if delay > 0:
                 self._sleep_fn(delay)
             self.restarts += 1
@@ -193,12 +294,27 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--backoff-base", type=float, default=1.0)
     p.add_argument("--backoff-factor", type=float, default=2.0)
     p.add_argument("--backoff-max", type=float, default=60.0)
+    p.add_argument("--backoff-jitter", type=float, default=0.0,
+                   help="bounded random backoff fraction (e.g. 0.5 adds "
+                        "up to +50%%) so fleets do not restart in "
+                        "lockstep")
     p.add_argument("--preempt-exit-code", type=int,
                    default=PREEMPTION_EXIT_CODE_DEFAULT,
                    help="sentinel exit code the preemption guard uses")
     p.add_argument("--elastic-config", default=None, metavar="DS_JSON",
                    help="master config with an elasticity block; exports "
                         "the valid world sizes to the child")
+    p.add_argument("--pool-file", default=None, metavar="PATH",
+                   help="file holding the surviving pool's device count; "
+                        "re-read before every launch to pick the largest "
+                        "admissible elastic world size")
+    p.add_argument("--restart-log", default=None, metavar="JSONL",
+                   help="append one JSON record per launch/exit "
+                        "transition (reason, world size, resume tag)")
+    p.add_argument("--simulate-cpu-devices", action="store_true",
+                   help="drills: export JAX_PLATFORMS=cpu and "
+                        "--xla_force_host_platform_device_count matching "
+                        "the chosen world size")
     p.add_argument("--no-verify", action="store_true",
                    help="skip manifest checksum verification during "
                         "checkpoint discovery (size/presence only)")
@@ -219,10 +335,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         backoff_base=args.backoff_base,
         backoff_factor=args.backoff_factor,
         backoff_max=args.backoff_max,
+        backoff_jitter=args.backoff_jitter,
         preempt_exit_code=args.preempt_exit_code,
         checkpoint_dir=args.checkpoint_dir,
         elastic_config=args.elastic_config,
         verify_checksums=not args.no_verify,
+        pool_file=args.pool_file,
+        restart_log=args.restart_log,
+        simulate_cpu_devices=args.simulate_cpu_devices,
     )
     return Supervisor(cmd, policy).run()
 

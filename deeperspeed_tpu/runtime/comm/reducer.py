@@ -73,6 +73,27 @@ def dequantize_int8_blocks(q, s):
     return (q.astype(jnp.float32) * s[:, None]).reshape(-1)
 
 
+def pairwise_slot_sum(x):
+    """Graph-fixed pairwise tree sum over the leading (slot) axis.
+
+    The grouping of additions depends only on ``x.shape[0]`` — never on
+    the device count or sharding — so the result is bit-identical on any
+    mesh. An odd remainder folds into slot 0 before each halving, keeping
+    the schedule deterministic for non-power-of-two slot counts. This is
+    the reduction primitive of the elastic "canonical slot" mode: a GSPMD
+    mean regroups its adds per topology and drifts by an ulp across world
+    sizes, which is enough to fork a loss curve.
+    """
+    c = x.shape[0]
+    while c > 1:
+        if c % 2:
+            x = jnp.concatenate([x[:1] + x[c - 1:c], x[1:c - 1]], axis=0)
+            c -= 1
+        x = x[0::2] + x[1::2]
+        c //= 2
+    return x[0]
+
+
 class GradReducer:
     """Bucketed gradient reduction over the data axis of a mesh.
 
@@ -89,13 +110,23 @@ class GradReducer:
     """
 
     def __init__(self, config: CommConfig, mesh, *, axis_name: str = DATA_AXIS,
-                 registry=None):
+                 registry=None, canonical: int = 0):
         self.cfg = config
         self.mesh = mesh
         self.axis = axis_name
         self.world = int(mesh.shape[axis_name])
+        # canonical-slot mode (elastic training): residuals and reduction
+        # math are keyed to C fixed slots instead of the world size, so
+        # checkpointed state is valid on any device count
+        self.canonical = int(canonical or 0)
         self.plan: Optional[bucketing.BucketPlan] = None
         self.hier_k = self._resolve_hierarchy()
+        if self.canonical and self.hier_k:
+            logger.warning(
+                "comm: hierarchical schedule is incompatible with the "
+                "canonical-slot elastic mode (per-group residuals are "
+                "world-size-shaped); using the flat schedule")
+            self.hier_k = None
         self._jit_cache: Dict = {}
         self._c_buckets = self._c_wire = None
         if registry is not None:
@@ -130,6 +161,13 @@ class GradReducer:
 
     def build_plan(self, tree) -> bucketing.BucketPlan:
         """Plan buckets from the parameter/grad tree (arrays or structs)."""
+        if self.canonical:
+            # world-free layout: bucket lengths (and therefore residual
+            # shapes and the plan fingerprint) must not change when the
+            # device count does
+            self.plan = bucketing.build_plan(
+                tree, self.cfg.bucket_bytes, self.cfg.block)
+            return self.plan
         pad_to = self.cfg.block * (self.world if self.world > 1 else 1)
         if self.hier_k:
             # chunks of both W and k must be whole blocks; k | W ensures
@@ -143,8 +181,13 @@ class GradReducer:
         return len(self.plan.buckets)
 
     def _residual_shapes(self, b: bucketing.Bucket) -> Dict[str, int]:
-        """Per-device residual vector lengths for one bucket."""
+        """Per-device (or per-slot, canonical mode) residual lengths."""
         L = b.padded
+        if self.canonical:
+            # per-SLOT single-phase residuals — C rows regardless of the
+            # world size (and even at world == 1, so a single-device
+            # checkpoint restores onto a pool bit-for-bit)
+            return {} if self.cfg.mode == "fp32" else {"e": L}
         if self.world == 1 or self.cfg.mode == "fp32":
             return {}
         if self.cfg.mode in ("bf16", "compressed"):
@@ -154,12 +197,14 @@ class GradReducer:
         return {"e": L, "e2": L // self.world}  # int8 flat two-phase
 
     def init_state(self) -> List[Dict[str, jax.Array]]:
-        """Zero residuals, stacked (world, n) and sharded P(data, None)."""
+        """Zero residuals, stacked (world, n) — or (canonical, n) in the
+        elastic canonical-slot mode — and sharded P(data, None)."""
+        rows = self.canonical or self.world
         sh = NamedSharding(self.mesh, P(self.axis, None))
         state = []
         for b in self.plan.buckets:
             state.append({
-                k: jax.device_put(np.zeros((self.world, n), np.float32), sh)
+                k: jax.device_put(np.zeros((rows, n), np.float32), sh)
                 for k, n in self._residual_shapes(b).items()})
         return state
 
@@ -170,9 +215,31 @@ class GradReducer:
 
     def state_fingerprint(self) -> Tuple:
         """Identity of (layout, mode, world) — residuals restored from a
-        checkpoint with a different fingerprint are dropped, not reused."""
-        return (self.cfg.mode, self.world, self.hier_k or 0, self.cfg.block,
+        checkpoint with a different fingerprint are dropped (or, when only
+        the world size differs and a compatible ``comm_plan`` rode along,
+        resharded by :mod:`...resilience.reshard`). The canonical mode
+        replaces the world term with ``("canonical", C)`` so residuals
+        match verbatim across elastic world-size flips."""
+        world_term = (("canonical", self.canonical) if self.canonical
+                      else self.world)
+        return (self.cfg.mode, world_term, self.hier_k or 0, self.cfg.block,
                 self.plan.fingerprint())
+
+    def plan_summary(self) -> Dict:
+        """JSON-serializable layout descriptor saved next to checkpointed
+        residuals; :func:`...resilience.reshard.reshard_comm_residuals`
+        uses it to decide whether (and how) a different-world restore can
+        reshape them instead of zeroing."""
+        return {
+            "mode": self.cfg.mode,
+            "world": self.world,
+            "block": self.cfg.block,
+            "hier_k": self.hier_k or 0,
+            "canonical": self.canonical,
+            "error_feedback": bool(self.cfg.error_feedback),
+            "bucket_lengths": [b.length for b in self.plan.buckets],
+            "bucket_padded": [b.padded for b in self.plan.buckets],
+        }
 
     # ------------------------------------------------------------------ #
     # per-bucket wire formats (per-device views, traced inside shard_map)
@@ -349,6 +416,68 @@ class GradReducer:
         return jax.tree.unflatten(treedef, outs), new_state
 
     # ------------------------------------------------------------------ #
+    # canonical-slot reduction (elastic training; no collectives)
+    # ------------------------------------------------------------------ #
+
+    def _reduce_canonical_flat(self, v, res):
+        """One bucket, canonical mode: (C, L) per-slot contributions ->
+        (bit-identical-on-any-mesh) mean over the slot axis.
+
+        Single-phase quantize->dequantize per slot with per-slot error
+        feedback, then the graph-fixed pairwise tree — no collective ops;
+        GSPMD materializes whatever data movement the tree implies, which
+        keeps the math independent of the device count."""
+        cfg = self.cfg
+        ef = cfg.error_feedback
+        C = self.canonical
+        if cfg.mode == "fp32":
+            return pairwise_slot_sum(v) / C, res
+        c = v + res["e"] if ef else v
+        if cfg.mode == "bf16":
+            out = c.astype(jnp.bfloat16).astype(jnp.float32)
+        elif cfg.mode == "compressed":
+            def qdq(row):
+                m, e = _compress_blocks(row, cfg.block)
+                return _decompress_blocks(m, e, row.shape[0])
+            out = jax.vmap(qdq)(c)
+        else:  # int8
+            def qdq(row):
+                q, s = quantize_int8_blocks(row, cfg.block)
+                return dequantize_int8_blocks(q, s)
+            out = jax.vmap(qdq)(c)
+        new_res = {"e": c - out} if ef else res
+        return pairwise_slot_sum(out) / C, new_res
+
+    def reduce_canonical(self, slot_tree, state):
+        """Reduce a tree of per-slot grads ((canonical, *shape) leaves,
+        slot axis sharded over the data axis) to the tree of slot means.
+
+        Traceable — the canonical-mode counterpart of
+        :meth:`reduce_stacked`; returns ``(mean_tree, new_state)`` with the
+        residual state keeping its (C, L) P(data, None) placement."""
+        if not self.canonical:
+            raise ValueError("reduce_canonical requires canonical mode")
+        leaves, treedef = jax.tree.flatten(slot_tree)
+        if len(leaves) != self.plan.n_leaves:
+            raise ValueError(
+                f"grad tree has {len(leaves)} leaves but the bucket plan "
+                f"was built for {self.plan.n_leaves}")
+        res_sh = NamedSharding(self.mesh, P(self.axis, None))
+        outs = [None] * self.plan.n_leaves
+        new_state = []
+        for b, rb in zip(self.plan.buckets, state):
+            flat = jax.vmap(lambda *ls: bucketing.pack(b, list(ls)))(
+                *[leaves[i] for i in b.leaf_ids])  # (C, padded)
+            flat = jax.lax.with_sharding_constraint(flat, res_sh)
+            red, nr = self._reduce_canonical_flat(flat, rb)
+            for i, leaf in zip(b.leaf_ids, bucketing.unpack(b, red)):
+                outs[i] = leaf
+            new_state.append({
+                k: jax.lax.with_sharding_constraint(a, res_sh)
+                for k, a in nr.items()})
+        return jax.tree.unflatten(treedef, outs), new_state
+
+    # ------------------------------------------------------------------ #
     # imperative per-bucket dispatch (backward()/step() path)
     # ------------------------------------------------------------------ #
 
@@ -378,6 +507,11 @@ class GradReducer:
         """Reduce bucket by bucket with one jitted dispatch each, wrapping
         every launch in a ``comm/reduce`` span and bumping the comm
         counters.  Same math as :meth:`reduce_stacked`."""
+        if self.canonical:
+            raise NotImplementedError(
+                "the imperative backward()/step() path does not support "
+                "the canonical-slot elastic mode (residuals are per-slot, "
+                "not per-device); use the fused train_batch() API")
         leaves, treedef = jax.tree.flatten(stacked_tree)
         if len(leaves) != self.plan.n_leaves:
             raise ValueError(

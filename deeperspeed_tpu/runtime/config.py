@@ -38,6 +38,11 @@ class TrainingConfig:
         self.world_size = world_size
         self.elasticity_enabled = False
         self.elastic_valid_world_sizes = None
+        # canonical reduction-shard count (0 = off): when set, grad
+        # reduction math is restructured to be bit-identical across every
+        # admissible world size, so an elastic resume continues the exact
+        # loss curve. See runtime/engine.py `_batch_grads_canonical`.
+        self.elastic_canonical_shards = 0
 
         self._handle_elasticity()
         self._initialize_params(self._param_dict)
@@ -57,6 +62,14 @@ class TrainingConfig:
             pd, world_size=self.world_size
         )
         self.elastic_valid_world_sizes = valid_gpus
+        self.elastic_canonical_shards = int(
+            elastic_dict.get("canonical_shards", 0)
+        )
+        if self.elastic_canonical_shards < 0:
+            raise ConfigError(
+                "elasticity.canonical_shards must be >= 0, got "
+                f"{self.elastic_canonical_shards}"
+            )
 
         ignore = elastic_dict.get(
             ec.IGNORE_NON_ELASTIC_BATCH_INFO, ec.IGNORE_NON_ELASTIC_BATCH_INFO_DEFAULT

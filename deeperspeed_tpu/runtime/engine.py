@@ -34,6 +34,7 @@ from ..checkpoint.serialization import (
     SHARDED_STATE_DIR,
     CheckpointEngine,
     load_sharded_tree,
+    load_sharded_tree_raw,
     model_state_filename,
     optim_state_filename,
     read_latest,
@@ -46,7 +47,7 @@ from ..checkpoint.serialization import (
 from ..ops.adam import DeepSpeedCPUAdam, FusedAdam
 from ..ops.lamb import FusedLamb
 from ..ops.sgd import SGD
-from ..monitor import get_monitor, init_monitor, trace_span
+from ..monitor import get_monitor, init_monitor, trace_instant, trace_span
 from ..resilience.manifest import resolve_load_tag
 from ..parallel.topology import DATA_AXIS, build_mesh, single_device_mesh
 from ..utils.logging import log_dist, logger
@@ -215,6 +216,9 @@ class Engine(ConfigAccessorsMixin):
             self._resilience = init_resilience(config.resilience_config())
         else:
             self._resilience = get_resilience_manager()
+        if self._resilience is not None:
+            # supervisor-restarted child: count it + record reason/world
+            self._resilience.note_restart_context()
 
         # the fused train step legitimately traces twice: the initial
         # state is an uncommitted single-device array, the step's output
@@ -292,6 +296,29 @@ class Engine(ConfigAccessorsMixin):
         # axis with quantized wire formats; error-feedback residuals live
         # in _comm_state (outside EngineState, threaded through the fused
         # step and checkpointed alongside the optimizer state)
+        # canonical-slot reduction (elasticity.canonical_shards): restructure
+        # the fused-step gradient reduction as C world-size-independent slots
+        # combined by a graph-fixed pairwise tree, so the loss curve is
+        # bit-identical across every admissible elastic world size. Resolved
+        # before the GradReducer below so comm residuals adopt the same
+        # (C, ...) world-free layout.
+        self.canonical_shards = 0
+        _canon = int(getattr(config, "elastic_canonical_shards", 0) or 0)
+        if _canon:
+            rows = (self.train_micro_batch_size_per_gpu()
+                    * self.data_parallel_size
+                    * self.gradient_accumulation_steps())
+            if rows % _canon != 0:
+                raise ValueError(
+                    f"elasticity.canonical_shards={_canon} must divide the "
+                    f"global batch rows ({rows})")
+            if _canon % self.data_parallel_size != 0:
+                raise ValueError(
+                    f"elasticity.canonical_shards={_canon} must be a "
+                    f"multiple of every admissible data-parallel size "
+                    f"(current: {self.data_parallel_size})")
+            self.canonical_shards = _canon
+
         self.comm = None
         self._comm_state = None
         self._comm_acc_reduced = None  # per-cycle backward() routing flag
@@ -318,7 +345,8 @@ class Engine(ConfigAccessorsMixin):
                 self.comm = GradReducer(
                     config.comm_config(), self.mesh,
                     registry=(self.monitor.registry
-                              if self.monitor is not None else None))
+                              if self.monitor is not None else None),
+                    canonical=self.canonical_shards)
                 self.comm.build_plan(params)
                 self._comm_state = self.comm.init_state()
 
@@ -769,11 +797,96 @@ class Engine(ConfigAccessorsMixin):
                        out_specs=out_specs, **_SHMAP_CHECK_KWARGS)
         return fn(state.params, scale, batch, rng)
 
+    def _batch_grads_canonical(self, state, batch, rng, C):
+        """Traced: world-size-invariant grads via C canonical slots.
+
+        The global batch (R rows) is reshaped to ``(C, R/C, ...)`` and each
+        slot's loss/grads are computed by one ``jax.vmap`` lane with a
+        per-SLOT rng (``fold_in(rng, slot)`` — not per gas microbatch, so
+        the stream is independent of how gas/micro split across world
+        sizes). The slot axis is sharding-constrained over the data axis;
+        because C is fixed by config, the program (and therefore every
+        reduction grouping) is identical on any device count. Returns
+        ``(slot_losses (C,), slot grads stacked (C, *shape))`` — callers
+        combine slots with :func:`pairwise_slot_sum`, a graph-fixed
+        pairwise tree, never a GSPMD mean.
+        """
+        scale = state.scaler.loss_scale
+        theta = None
+        if self._pld_active():
+            batch, theta = batch
+
+        def resh(x):
+            return jnp.reshape(x, (C, x.shape[0] // C) + x.shape[1:])
+
+        batch_c = jax.tree.map(resh, batch)
+        slot_sharding = jax.sharding.NamedSharding(self.mesh, P(DATA_AXIS))
+        batch_c = jax.tree.map(
+            lambda x: jax.lax.with_sharding_constraint(x, slot_sharding),
+            batch_c)
+
+        def one(mb, idx):
+            if theta is not None:
+                mb = (mb, theta)
+            key = jax.random.fold_in(rng, idx)
+            return self._micro_grads(state.params, mb, key, scale)
+
+        losses, slot_grads = jax.vmap(one, in_axes=(0, 0))(
+            batch_c, jnp.arange(C))
+        slot_grads = jax.tree.map(
+            lambda g: jax.lax.with_sharding_constraint(g, slot_sharding),
+            slot_grads)
+        return losses, slot_grads
+
     def _train_batch_fn(self):
         """Fully fused jitted step: scan over gas microbatches + update."""
 
         def build():
             gas = self.gradient_accumulation_steps()
+            C = self.canonical_shards
+
+            if C:
+                # canonical path: slots subsume the gas microbatches (one
+                # vmap lane per slot; the scaled-grad divisor is C inside
+                # the slot mean, so the update body unscales with gas=1)
+                from .comm.reducer import pairwise_slot_sum
+
+                if self.comm is not None:
+                    def canon_comm_fn(state, comm_state, batch, lr, rng):
+                        rng = self._fold_rng(rng)
+                        losses, slots = self._batch_grads_canonical(
+                            state, batch, rng, C)
+                        loss = pairwise_slot_sum(losses) / C
+                        grads, new_comm = self.comm.reduce_canonical(
+                            slots, comm_state)
+                        grads = jax.tree.map(
+                            lambda g: g.astype(self._grad_dtype), grads)
+                        grads = partition.constrain(
+                            grads, self.grad_specs, self.mesh)
+                        new_state, metrics = self._apply_update_body(
+                            state, grads, lr, 1)
+                        metrics["loss"] = loss
+                        return new_state, new_comm, metrics
+
+                    return jax.jit(canon_comm_fn, donate_argnums=(0, 1))
+
+                def canon_fn(state, batch, lr, rng):
+                    rng = self._fold_rng(rng)
+                    losses, slots = self._batch_grads_canonical(
+                        state, batch, rng, C)
+                    loss = pairwise_slot_sum(losses) / C
+                    grads = jax.tree.map(
+                        lambda g: (pairwise_slot_sum(g) / C).astype(
+                            self._grad_dtype),
+                        slots)
+                    grads = partition.constrain(
+                        grads, self.grad_specs, self.mesh)
+                    new_state, metrics = self._apply_update_body(
+                        state, grads, lr, 1)
+                    metrics["loss"] = loss
+                    return new_state, metrics
+
+                return jax.jit(canon_fn, donate_argnums=(0,))
 
             if self.comm is not None:
                 # comm path: local grads via shard_map, explicit bucketed
@@ -1475,6 +1588,14 @@ class Engine(ConfigAccessorsMixin):
         reps = jax.tree.map(lambda _: NamedSharding(self.mesh, P()), tree)
         return jax.jit(lambda t: t, out_shardings=reps)(tree)
 
+    def _global_rows(self) -> int:
+        """Rows consumed per optimizer step (micro * dp * gas) — the unit
+        the datapipe cursor advances by; constant across elastic world
+        flips (elasticity co-designs micro/gas so the product holds)."""
+        return (self.train_micro_batch_size_per_gpu()
+                * self.data_parallel_size
+                * self.gradient_accumulation_steps())
+
     def _host_checkpoint_payload(self, state=None, client_state=None):
         """Blocking device->host snapshot of everything a legacy-layout
         checkpoint stores, keyed by destination filename. The resilience
@@ -1492,6 +1613,10 @@ class Engine(ConfigAccessorsMixin):
             "micro_steps": self.micro_steps,
             "dp_world_size": self.data_parallel_size,
             "mp_world_size": int(self.mesh.shape.get("model", 1)),
+            # rows per optimizer step at save time: the datapipe cursor
+            # remap on an elastic (different-world) resume checks this to
+            # certify the sample stream continues exactly
+            "global_rows": self._global_rows(),
             # bounds the per-rank offload-file scan on load (stale files
             # from an older, larger save into the same tag are ignored)
             "process_count": jax.process_count(),
@@ -1521,16 +1646,50 @@ class Engine(ConfigAccessorsMixin):
             optim_states["comm"] = to_host(self._comm_state)
             optim_states["comm_fingerprint"] = repr(
                 self.comm.state_fingerprint())
+            # layout descriptor for the elastic reshard path: a resume at
+            # a different world size reshapes the residuals from this
+            # instead of zeroing them
+            optim_states["comm_plan"] = self.comm.plan_summary()
         return {
             model_state_filename(): model_states,
             optim_state_filename(): optim_states,
         }
 
-    def _restore_comm_state(self, host_state, fingerprint):
+    def _reshard_comm_residuals(self, saved_buckets, saved_plan) -> bool:
+        """Elastic restore of comm residuals whose checkpointed shape bakes
+        in a DIFFERENT world size: rebuild them for the running topology
+        via resilience/reshard.py instead of zeroing. True on success."""
+        from ..resilience.reshard import reshard_comm_residuals
+
+        target_plan = self.comm.plan_summary()
+        resharded = reshard_comm_residuals(
+            saved_buckets, saved_plan, target_plan)
+        if resharded is None:
+            return False
+        try:
+            self._comm_state = jax.tree.map(
+                lambda x, s: jax.device_put(np.asarray(x, np.float32), s),
+                resharded, self.comm.state_shardings())
+        except Exception as e:
+            logger.warning(
+                "placing resharded comm residuals failed (%s): error "
+                "feedback restarts from zero", e)
+            return False
+        w_from = saved_plan.get("world") if isinstance(saved_plan, dict) \
+            else None
+        logger.info(
+            "comm residuals resharded for the new topology (world %s -> "
+            "%s)", w_from, target_plan["world"])
+        trace_instant("resilience/comm_reshard", lane="resilience",
+                      world_from=w_from, world_to=target_plan["world"])
+        return True
+
+    def _restore_comm_state(self, host_state, fingerprint, comm_plan=None):
         """Re-place checkpointed error-feedback residuals. Residuals from
-        a different bucket layout / mode / world size are useless (and
-        misapplying them corrupts gradients), so a fingerprint mismatch
-        keeps the fresh zeros instead."""
+        a different bucket layout / mode are useless (and misapplying them
+        corrupts gradients) — a fingerprint mismatch first attempts the
+        elastic world-size reshard (when a compatible ``comm_plan`` rode
+        along), then keeps the fresh zeros."""
         if host_state is None:
             if any(True for _ in jax.tree.leaves(self._comm_state)):
                 logger.warning(
@@ -1539,6 +1698,8 @@ class Engine(ConfigAccessorsMixin):
                     "quantization error)")
             return
         if fingerprint != repr(self.comm.state_fingerprint()):
+            if self._reshard_comm_residuals(host_state, comm_plan):
+                return
             logger.warning(
                 "checkpointed comm residuals were saved under a different "
                 "bucket layout/mode/world (fingerprint mismatch): error "
@@ -1651,6 +1812,7 @@ class Engine(ConfigAccessorsMixin):
                 "micro_steps": self.micro_steps,
                 "dp_world_size": self.data_parallel_size,
                 "mp_world_size": int(self.mesh.shape.get("model", 1)),
+                "global_rows": self._global_rows(),
                 "zero_stage": self.zero_stage,
                 "lr_scheduler": (
                     self.lr_scheduler.state_dict() if self.lr_scheduler else {}
@@ -1663,6 +1825,7 @@ class Engine(ConfigAccessorsMixin):
             }
             if self.comm is not None:
                 meta["comm_fingerprint"] = repr(self.comm.state_fingerprint())
+                meta["comm_plan"] = self.comm.plan_summary()
             ck.save(model_state_filename(), meta)
             from ..checkpoint.zero_to_fp32 import write_recovery_stub
 
@@ -1785,10 +1948,25 @@ class Engine(ConfigAccessorsMixin):
                         "sharded comm residual restore failed (%s): error "
                         "feedback restarts from zero", e)
             else:
-                logger.warning(
-                    "checkpointed comm residuals were saved under a "
-                    "different bucket layout/mode/world (fingerprint "
-                    "mismatch): error feedback restarts from zero")
+                # the fingerprint bakes in the world size: on an elastic
+                # resume the residual arrays have a DIFFERENT global shape
+                # than the running reducer's, so they load raw (no
+                # abstract target) and reshape via resilience/reshard.py
+                resharded = False
+                try:
+                    raw = load_sharded_tree_raw(comm_dir)
+                    resharded = self._reshard_comm_residuals(
+                        raw.get("buckets") if isinstance(raw, dict)
+                        else None,
+                        meta.get("comm_plan"))
+                except Exception as e:
+                    logger.warning(
+                        "raw comm residual read failed (%s)", e)
+                if not resharded:
+                    logger.warning(
+                        "checkpointed comm residuals were saved under a "
+                        "different bucket layout/mode/world (fingerprint "
+                        "mismatch): error feedback restarts from zero")
         if state.master is not None and not master_restored:
             # no master came off disk (params-only load, or a checkpoint
             # saved without one): re-derive it from the restored params, or
@@ -1807,7 +1985,11 @@ class Engine(ConfigAccessorsMixin):
         self.micro_steps = int(meta.get("micro_steps", 0))
         if self.datapipe is not None:
             if meta.get("datapipe"):
-                self.datapipe.load_state_dict(meta["datapipe"])
+                from ..resilience.reshard import remap_data_state
+
+                self.datapipe.load_state_dict(remap_data_state(
+                    meta["datapipe"], meta.get("global_rows"),
+                    self._global_rows()))
             else:
                 logger.warning(
                     "checkpoint %s carries no datapipe state (saved "
@@ -1843,12 +2025,13 @@ class Engine(ConfigAccessorsMixin):
         # checkpoint interval, never the run)
         verify = (self._resilience.cfg.verify_on_load
                   if self._resilience is not None else True)
-        tag, fell_back = resolve_load_tag(load_dir, str(tag),
+        requested = str(tag)
+        tag, fell_back = resolve_load_tag(load_dir, requested,
                                           verify_checksums=verify)
         if tag is None:
             return None, {}
         if fell_back and self._resilience is not None:
-            self._resilience.note_fallback()
+            self._resilience.note_fallback(skipped_tag=requested)
         ck = CheckpointEngine(load_dir, str(tag))
         if os.path.isdir(ck.path(SHARDED_STATE_DIR)):
             loaded = self._load_checkpoint_sharded(
@@ -1935,7 +2118,8 @@ class Engine(ConfigAccessorsMixin):
             if self.comm is not None:
                 self._restore_comm_state(
                     optim_states.get("comm"),
-                    optim_states.get("comm_fingerprint"))
+                    optim_states.get("comm_fingerprint"),
+                    optim_states.get("comm_plan"))
 
         state = state._replace(
             skipped=jnp.asarray(model_states.get("skipped_steps", 0), jnp.int32)
@@ -1948,7 +2132,11 @@ class Engine(ConfigAccessorsMixin):
         self.micro_steps = int(model_states.get("micro_steps", 0))
         if self.datapipe is not None:
             if model_states.get("datapipe"):
-                self.datapipe.load_state_dict(model_states["datapipe"])
+                from ..resilience.reshard import remap_data_state
+
+                self.datapipe.load_state_dict(remap_data_state(
+                    model_states["datapipe"],
+                    model_states.get("global_rows"), self._global_rows()))
             else:
                 logger.warning(
                     "checkpoint %s carries no datapipe state (saved "

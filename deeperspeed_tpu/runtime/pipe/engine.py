@@ -35,7 +35,7 @@ from ...checkpoint.serialization import (
     to_host,
     write_latest,
 )
-from ...monitor import get_monitor, trace_span
+from ...monitor import get_monitor, trace_instant, trace_span
 from ...parallel.topology import DATA_AXIS, MODEL_AXIS, PIPE_AXIS
 from ...utils.logging import log_dist, logger
 from ...utils.timer import SynchronizedWallClockTimer, ThroughputTimer
@@ -511,8 +511,49 @@ class PipelineEngine(ConfigAccessorsMixin):
                 red.build_plan(g)
                 self._comm_reducers[s] = red
                 self._comm_states[s] = red.init_transform_state()
+                self._maybe_restore_comm_state(s, red)
             self.stage_grads[s], self._comm_states[s] = red.transform_dispatch(
                 g, self._comm_states[s])
+
+    def _maybe_restore_comm_state(self, s: int, red) -> None:
+        """Consume a checkpointed transform-residual restore for stage
+        ``s``. Reducers build lazily at the first reduce, so
+        load_checkpoint stashes the raw checkpoint data and this applies
+        it once the stage's bucket plan exists — resharding the padded
+        tails when the checkpoint was written at a different world size."""
+        pending = getattr(self, "_pending_comm_restore", None)
+        if not pending:
+            return
+        states, plans = pending
+
+        def ith(container, i):
+            # msgpack round-trips lists as {str(i): v} dicts
+            if isinstance(container, dict):
+                return container.get(str(i))
+            if isinstance(container, (list, tuple)) and i < len(container):
+                return container[i]
+            return None
+
+        saved = ith(states, s)
+        if saved is None:
+            return
+        from ...resilience.reshard import reshard_transform_residuals
+
+        plan = ith(plans, s) if plans is not None else None
+        resharded = reshard_transform_residuals(
+            saved, plan, red.plan_summary())
+        if resharded is None:
+            logger.warning(
+                "stage %d comm residuals could not be restored: error "
+                "feedback restarts from zero", s)
+            return
+        self._comm_states[s] = [
+            {k: jnp.asarray(v, jnp.float32) for k, v in b.items()}
+            for b in resharded]
+        w_from = plan.get("world") if isinstance(plan, dict) else None
+        if w_from is not None and w_from != red.world:
+            trace_instant("resilience/comm_reshard", lane="resilience",
+                          stage=s, world_from=w_from, world_to=red.world)
 
     def _stage_norm_view(self, g, stage_id: int):
         """The stage's grads with tied duplicates dropped: after
@@ -885,6 +926,15 @@ class PipelineEngine(ConfigAccessorsMixin):
             "skipped_steps": self.skipped_steps,
             "loss_scaler": to_host(self._dyn_state._asdict()),
         }
+        if any(r is not None for r in self._comm_reducers):
+            # per-stage transform residuals + their bucket-plan identity,
+            # so an elastic resume reshards (repads) instead of zeroing
+            meta["comm_states"] = [
+                to_host(st) if st is not None else None
+                for st in self._comm_states]
+            meta["comm_plans"] = [
+                r.plan_summary() if r is not None else None
+                for r in self._comm_reducers]
         ck.save("pipeline_engine_states.msgpack", meta)
         if save_latest:
             write_latest(save_dir, str(tag))
@@ -949,6 +999,13 @@ class PipelineEngine(ConfigAccessorsMixin):
                     self.stage_opt[s],
                     restored,
                 )
+        if load_optimizer_states and meta.get("comm_states") is not None \
+                and self._comm_cfg is not None:
+            # reducers build lazily at the first reduce; stash the raw
+            # residuals (+ plans) and let _maybe_restore_comm_state apply
+            # them per stage once the bucket plans exist
+            self._pending_comm_restore = (
+                meta["comm_states"], meta.get("comm_plans"))
         if load_lr_scheduler_states and self.lr_scheduler and meta.get("lr_scheduler"):
             self.lr_scheduler.load_state_dict(meta["lr_scheduler"])
         log_dist(f"loaded pipeline checkpoint {ck.ckpt_dir}", ranks=[0])

@@ -1,5 +1,11 @@
 """Elasticity (parity with reference tests/unit/test_elastic.py)."""
 
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
 import pytest
 
 from deeperspeed_tpu.elasticity import (
@@ -119,3 +125,365 @@ def test_config_rejects_batch_params_with_elasticity():
     }
     with pytest.raises(ConfigError):
         TrainingConfig(ds, world_size=8)
+
+
+# --------------------------------------------------------------------- #
+# elastic_world_sizes edge cases + supervisor env round trip
+# --------------------------------------------------------------------- #
+
+
+def test_elastic_world_sizes_edge_cases():
+    from deeperspeed_tpu.elasticity import elastic_world_sizes
+
+    # missing / non-dict / disabled block -> []
+    assert elastic_world_sizes({}) == []
+    assert elastic_world_sizes(None) == []
+    assert elastic_world_sizes(
+        {"elasticity": {"enabled": False,
+                        "max_train_batch_size": 64,
+                        "micro_batch_sizes": [4]}}) == []
+    # unsatisfiable: no micro batch fits under the max -> [] (not raise)
+    assert elastic_world_sizes(
+        {"elasticity": {"enabled": True,
+                        "max_train_batch_size": 5,
+                        "micro_batch_sizes": [7],
+                        "min_gpus": 1, "max_gpus": 8,
+                        "version": 0.1}}) == []
+    # single admissible size
+    assert elastic_world_sizes(
+        {"elasticity": {"enabled": True,
+                        "max_train_batch_size": 4,
+                        "micro_batch_sizes": [4],
+                        "min_gpus": 1, "max_gpus": 1,
+                        "version": 0.1}}) == [1]
+    # the drill geometry: micro 4, final 64 -> worlds {4, 8, 16}
+    assert elastic_world_sizes(
+        {"elasticity": {"enabled": True,
+                        "max_train_batch_size": 64,
+                        "micro_batch_sizes": [4],
+                        "min_gpus": 4, "max_gpus": 16,
+                        "version": 0.1}}) == [4, 8, 16]
+
+
+def test_elastic_world_sizes_supervisor_env_round_trip(tmp_path):
+    """DS_TPU_ELASTIC_WORLD_SIZES exported by the supervisor parses back
+    to exactly elastic_world_sizes(config)."""
+    import json
+
+    from deeperspeed_tpu.elasticity import elastic_world_sizes
+    from deeperspeed_tpu.resilience import Supervisor, SupervisorPolicy
+
+    ds = {"elasticity": {"enabled": True, "max_train_batch_size": 64,
+                         "micro_batch_sizes": [4], "min_gpus": 4,
+                         "max_gpus": 16, "version": 0.1}}
+    cfg = str(tmp_path / "ds.json")
+    with open(cfg, "w") as f:
+        json.dump(ds, f)
+    seen = {}
+
+    def fake_run(cmd, env):
+        seen["sizes"] = env.get("DS_TPU_ELASTIC_WORLD_SIZES")
+        return 0
+
+    sup = Supervisor(["trainer"], SupervisorPolicy(elastic_config=cfg),
+                     run_fn=fake_run)
+    assert sup.run() == 0
+    parsed = [int(s) for s in seen["sizes"].split(",")]
+    assert parsed == elastic_world_sizes(ds)
+
+
+def test_config_canonical_shards():
+    from deeperspeed_tpu.runtime.config import ConfigError, TrainingConfig
+
+    ds = {
+        "elasticity": {
+            "enabled": True, "max_train_batch_size": 64,
+            "micro_batch_sizes": [4], "min_gpus": 4, "max_gpus": 16,
+            "version": 0.1, "canonical_shards": 16,
+        }
+    }
+    cfg = TrainingConfig(ds, world_size=8)
+    assert cfg.elastic_canonical_shards == 16
+    bad = {"elasticity": dict(ds["elasticity"], canonical_shards=-1)}
+    with pytest.raises(ConfigError):
+        TrainingConfig(bad, world_size=8)
+    # absent -> off
+    plain = {"train_batch_size": 64}
+    assert TrainingConfig(plain, world_size=8).elastic_canonical_shards == 0
+
+
+# --------------------------------------------------------------------- #
+# world-size resharding of comm residuals / datapipe state (host-side)
+# --------------------------------------------------------------------- #
+
+
+def _plan(world, lengths, padded, mode="int8", ef=True, hier=None,
+          canonical=0):
+    return {"mode": mode, "world": world, "block": 256, "hier_k": hier,
+            "canonical": canonical, "error_feedback": ef,
+            "bucket_lengths": list(lengths), "bucket_padded": list(padded)}
+
+
+def test_plans_reshardable_msgpack_normalization():
+    """msgpack round-trips the saved plan's lists as index-keyed dicts
+    ({'0': v}); the compat check must still see them as equal."""
+    from deeperspeed_tpu.resilience import plans_reshardable
+
+    saved = _plan(8, [1072], [1280])
+    saved["bucket_lengths"] = {"0": 1072}
+    saved["bucket_padded"] = {"0": 1280}
+    assert plans_reshardable(saved, _plan(4, [1072], [1280])) is None
+    # genuinely different layouts still refuse
+    assert plans_reshardable(saved, _plan(4, [999], [1280])) is not None
+    assert plans_reshardable(None, _plan(4, [1072], [1280])) is not None
+    # hierarchical residuals are per-group: reset, not reshard
+    assert plans_reshardable(_plan(8, [1072], [1280], hier=4),
+                             _plan(4, [1072], [1280])) is not None
+    # canonical mode residuals have world-independent shapes: the
+    # reshard path is only for the classic (W, n) layout
+    assert plans_reshardable(_plan(8, [1072], [1280], canonical=16),
+                             _plan(4, [1072], [1280])) is not None
+
+
+def test_reshard_comm_residuals_e_sum_preserving():
+    import numpy as np
+
+    from deeperspeed_tpu.resilience import reshard_comm_residuals
+
+    rs = np.random.RandomState(0)
+    length, padded = 100, 128
+    e = np.zeros((8, padded), np.float32)
+    e[:, :length] = rs.randn(8, length)
+    out = reshard_comm_residuals(
+        [{"e": e}], _plan(8, [length], [padded]),
+        _plan(4, [length], [padded]))
+    assert out is not None and out[0]["e"].shape == (4, padded)
+    # error feedback only needs the SUM over devices preserved
+    np.testing.assert_allclose(out[0]["e"].sum(axis=0),
+                               e.sum(axis=0), rtol=0, atol=1e-5)
+    # pad region stays zero
+    assert not out[0]["e"][:, length:].any()
+    # growing the world works too (8 -> 16: tail rows stay zero)
+    up = reshard_comm_residuals(
+        [{"e": e}], _plan(8, [length], [padded]),
+        _plan(16, [length], [padded]))
+    assert up[0]["e"].shape == (16, padded)
+    np.testing.assert_allclose(up[0]["e"].sum(axis=0), e.sum(axis=0),
+                               rtol=0, atol=1e-5)
+
+
+def test_reshard_comm_residuals_e2_positional_exact():
+    import numpy as np
+
+    from deeperspeed_tpu.resilience import reshard_comm_residuals
+
+    rs = np.random.RandomState(1)
+    # int8 flat second phase: rows are positional chunks of the padded
+    # vector. 8 devices x chunk 16 = padded 128; new world 4 -> padded
+    # may differ (re-padding for divisibility)
+    old_padded, new_padded = 128, 128
+    e2 = rs.randn(8, old_padded // 8).astype(np.float32)
+    out = reshard_comm_residuals(
+        [{"e2": e2}], _plan(8, [100], [old_padded]),
+        _plan(4, [100], [new_padded]))
+    assert out[0]["e2"].shape == (4, new_padded // 4)
+    # positionally exact: the reassembled global vector is unchanged
+    np.testing.assert_array_equal(out[0]["e2"].reshape(-1),
+                                  e2.reshape(-1))
+
+
+def test_reshard_transform_residuals_repad():
+    import numpy as np
+
+    from deeperspeed_tpu.resilience import reshard_transform_residuals
+
+    v = np.arange(96, dtype=np.float32)
+    # padding is the only world-dependent part: truncate or zero-extend
+    out = reshard_transform_residuals(
+        [{"e": v}], _plan(8, [90], [96]), _plan(4, [90], [128]))
+    assert out[0]["e"].shape == (128,)
+    np.testing.assert_array_equal(out[0]["e"][:96], v)
+    assert not out[0]["e"][96:].any()
+    down = reshard_transform_residuals(
+        [{"e": v}], _plan(8, [90], [96]), _plan(4, [90], [92]))
+    np.testing.assert_array_equal(down[0]["e"], v[:92])
+    # layout change -> None (caller keeps zeros)
+    assert reshard_transform_residuals(
+        [{"e": v}], _plan(8, [90], [96]),
+        _plan(4, [91], [96])) is None
+
+
+def test_remap_data_state_identity_and_warning():
+    import logging
+
+    from deeperspeed_tpu.resilience import remap_data_state
+    from deeperspeed_tpu.utils.logging import logger
+
+    records = []
+
+    class _Trap(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    trap = _Trap()
+    logger.addHandler(trap)
+    try:
+        sd = {"epoch": 1, "cursor": 320, "step": 5, "samples": 320,
+              "seed": 7, "fingerprint": "abc", "offset": 0}
+        # elastic flip: global rows unchanged -> identity, no warning
+        assert remap_data_state(sd, 64, 64) == sd
+        assert remap_data_state(None, 64, 64) is None
+        # pre-elastic checkpoint (no saved rows) -> identity
+        assert remap_data_state(sd, None, 64) == sd
+        assert not any("global batch rows changed" in m for m in records)
+        assert remap_data_state(sd, 64, 32) == sd
+        assert any("global batch rows changed" in m for m in records)
+    finally:
+        logger.removeHandler(trap)
+
+
+# --------------------------------------------------------------------- #
+# cross-world resume: residuals resharded (not zeroed), drill flips
+# --------------------------------------------------------------------- #
+
+_RESHARD_TRAINER = """\
+import os, sys
+W = int(sys.argv[1]); PHASE = sys.argv[2]; CKPT = sys.argv[3]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={W}"
+sys.path.insert(0, sys.argv[4])
+import jax, numpy as np
+import deeperspeed_tpu as ds
+from tests.simple_model import init_linear_stack, linear_stack_loss
+
+DIMS = [16, 32, 16]
+params = init_linear_stack(jax.random.PRNGKey(0), DIMS)
+cfg = {
+    "train_micro_batch_size_per_gpu": 64 // W,
+    "gradient_accumulation_steps": 1,
+    "steps_per_print": 1000,
+    "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+    "zero_optimization": {"stage": 0},
+    "comm": {"mode": "int8", "bucket_mb": 0.005, "error_feedback": True},
+    "checkpoint": {"sharded_io": True},
+}
+engine, _, _, _ = ds.initialize(
+    model=linear_stack_loss, model_parameters=params, config=cfg)
+
+def batch(seed):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(64, DIMS[0])).astype(np.float32)
+    y = (np.tanh(x[:, :DIMS[-1]]) * 0.5).astype(np.float32)
+    return (x, y)
+
+def res_l1():
+    return sum(float(abs(np.asarray(a)).sum())
+               for d in engine._comm_state for a in d.values())
+
+def res_sum_e():
+    return sum(float(np.asarray(d["e"]).sum())
+               for d in engine._comm_state if "e" in d)
+
+if PHASE == "save":
+    for s in range(3):
+        engine.train_batch(batch(s))
+    print(f"L1 {res_l1():.9e}")
+    print(f"ESUM {res_sum_e():.17e}")
+    engine.save_checkpoint(CKPT)
+else:
+    path, _ = engine.load_checkpoint(CKPT)
+    assert path is not None, "load failed"
+    print(f"L1 {res_l1():.9e}")
+    print(f"ESUM {res_sum_e():.17e}")
+    engine.train_batch(batch(3))
+    print("STEP_OK")
+"""
+
+
+def _run_reshard_phase(script, world, phase, ckpt, repo):
+    env = dict(os.environ, PYTHONPATH=repo + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, script, str(world), phase, ckpt, repo],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr[-3000:]
+    out = {}
+    for line in proc.stdout.splitlines():
+        parts = line.split()
+        if len(parts) == 2:
+            out[parts[0]] = parts[1]
+    return out, proc.stdout
+
+
+def test_cross_world_comm_residuals_resharded_not_zeroed(tmp_path):
+    """A checkpoint with classic (W, n) int8 error-feedback residuals
+    written on 8 devices restores on 4: the residuals come back non-zero
+    with their device-sum preserved, and training continues."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = str(tmp_path / "trainer.py")
+    with open(script, "w") as f:
+        f.write(_RESHARD_TRAINER)
+    ckpt = str(tmp_path / "ckpt")
+
+    saved, _ = _run_reshard_phase(script, 8, "save", ckpt, repo)
+    loaded, stdout = _run_reshard_phase(script, 4, "load", ckpt, repo)
+    assert "STEP_OK" in stdout
+    # resharded, NOT zeroed
+    assert float(saved["L1"]) > 0.0
+    assert float(loaded["L1"]) > 0.0
+    # the e-regroup preserves the sum over devices exactly up to fp32
+    # re-association
+    assert abs(float(loaded["ESUM"]) - float(saved["ESUM"])) <= (
+        1e-5 * max(1.0, abs(float(saved["ESUM"]))))
+
+
+def _load_drill_module():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "elastic_drill", os.path.join(repo, "scripts", "elastic_drill.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_elastic_drill_world_flips(tmp_path):
+    """Short supervised drill: SIGKILL on 8 simulated devices, resume on
+    4, SIGKILL again, finish on 16 — every per-step loss bit-identical
+    to the uninterrupted reference and the datapipe token stream exact."""
+    drill = _load_drill_module()
+    result = drill.run_drill(steps=8, kills=((3, 4), (5, 16)))
+    assert result["pass"], result
+    assert result["world_history"] == [8, 4, 16]
+    assert result["loss_mismatches"] == []
+    assert result["loss_steps_covered"]
+    # bit-identical: canonical-slot reduction makes the loss curve
+    # world-size invariant
+    assert result["max_abs_loss_delta"] == 0.0
+    assert result["token_stream_digest_match"]
+    assert [f["world_to"] for f in result["flips"]] == [4, 16]
+    # each resume picked up a committed tag strictly before the kill
+    assert [f["resumed_from_step"] for f in result["flips"]] == [2, 4]
+
+
+@pytest.mark.slow
+def test_elastic_drill_full(tmp_path):
+    """Full scripts/elastic_drill.py run (24 steps, default schedule)
+    producing the BENCH_elastic.json report."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = str(tmp_path / "BENCH_elastic.json")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "scripts", "elastic_drill.py"),
+         "--out", out],
+        capture_output=True, text=True, timeout=1200,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    with open(out) as f:
+        report = json.load(f)
+    assert report["pass"]
+    assert report["max_abs_loss_delta"] == 0.0
+    assert report["token_stream_digest_match"]
+    assert report["world_history"] == [8, 4, 16]
+    assert len(report["flips"]) == 2
+    assert all(f["resume_s"] > 0 for f in report["flips"])

@@ -516,3 +516,215 @@ def test_resilience_drill_full(tmp_path):
     assert report["blocked_ratio"] < 0.25
     assert report["blocked_vs_legacy_ratio"] < 0.25
     assert report["drill"]["losses_match_reference"]
+
+
+# --------------------------------------------------------------------- #
+# elastic supervisor: jitter, pool selection, restart log, telemetry
+# --------------------------------------------------------------------- #
+
+
+def test_compute_backoff_jitter_bounded():
+    # jitter off keeps the pure schedule (the exact values above)
+    assert compute_backoff(3, 1.0, 2.0, 60.0, jitter=0.0) == 4.0
+    # injected rand makes the jitter deterministic: delay * (1 + j * u)
+    assert compute_backoff(3, 1.0, 2.0, 60.0, jitter=0.5,
+                           rand=lambda: 1.0) == 6.0
+    assert compute_backoff(3, 1.0, 2.0, 60.0, jitter=0.5,
+                           rand=lambda: 0.0) == 4.0
+    # the jittered delay still respects the cap
+    assert compute_backoff(10, 1.0, 2.0, 60.0, jitter=0.5,
+                           rand=lambda: 1.0) == 60.0
+
+
+_ELASTIC_CFG = {
+    "elasticity": {
+        "enabled": True,
+        "max_train_batch_size": 64,
+        "micro_batch_sizes": [4],
+        "min_gpus": 4,
+        "max_gpus": 16,
+        "version": 0.1,
+        "ignore_non_elastic_batch_info": True,
+    }
+}
+
+
+def test_supervisor_picks_largest_admissible_world(tmp_path):
+    cfg = str(tmp_path / "ds.json")
+    with open(cfg, "w") as f:
+        json.dump(_ELASTIC_CFG, f)
+    pool = str(tmp_path / "pool")
+    seen = []
+
+    def fake_run(cmd, env):
+        seen.append({k: env.get(k) for k in
+                     ("DS_TPU_WORLD_SIZE", "DS_TPU_ELASTIC_WORLD_SIZES",
+                      "JAX_PLATFORMS", "XLA_FLAGS")})
+        return 0
+
+    for pool_n, want in ((8, 8), (6, 4), (16, 16), (100, 16)):
+        with open(pool, "w") as f:
+            f.write(f"{pool_n}\n")
+        sup = Supervisor(
+            ["trainer"],
+            SupervisorPolicy(elastic_config=cfg, pool_file=pool,
+                             simulate_cpu_devices=True),
+            run_fn=fake_run)
+        assert sup.run() == 0
+        assert seen[-1]["DS_TPU_WORLD_SIZE"] == str(want)
+        assert seen[-1]["DS_TPU_ELASTIC_WORLD_SIZES"] == "4,8,16"
+        assert seen[-1]["JAX_PLATFORMS"] == "cpu"
+        assert (f"--xla_force_host_platform_device_count={want}"
+                in seen[-1]["XLA_FLAGS"])
+        assert sup.world_history == [want]
+    # a pool too small for any admissible size launches without the env
+    # (the child fails fast; the backoff retries while the pool recovers)
+    with open(pool, "w") as f:
+        f.write("3\n")
+    sup = Supervisor(
+        ["trainer"],
+        SupervisorPolicy(elastic_config=cfg, pool_file=pool),
+        run_fn=fake_run)
+    assert sup.run() == 0
+    assert seen[-1]["DS_TPU_WORLD_SIZE"] is None
+    assert sup.world_history == [None]
+
+
+def test_supervisor_restart_log_and_reason_env(tmp_path):
+    log = str(tmp_path / "restarts.jsonl")
+    rcs = iter([1, 86, 0])
+    reasons = []
+
+    def fake_run(cmd, env):
+        reasons.append(env.get("DS_TPU_RESTART_REASON"))
+        return next(rcs)
+
+    sup = Supervisor(
+        ["trainer"],
+        SupervisorPolicy(max_restarts=3, backoff_base=0.0, restart_log=log),
+        run_fn=fake_run, sleep_fn=lambda s: None)
+    assert sup.run() == 0
+    # the reason env tells the child WHY it was relaunched
+    assert reasons == [None, "crash", "preemption"]
+    with open(log) as f:
+        events = [json.loads(line) for line in f]
+    assert [(e["event"], e.get("reason")) for e in events] == [
+        ("launch", "initial"), ("exit", "crash"),
+        ("launch", "crash"), ("exit", "preemption"),
+        ("launch", "preemption"), ("exit", "done"),
+    ]
+    assert all("ts" in e for e in events)
+    assert events[1]["code"] == 1 and events[3]["code"] == 86
+
+
+def test_spot_pool_simulator_schedule(tmp_path):
+    from deeperspeed_tpu.resilience import PoolEvent, SpotPoolSimulator
+
+    pool = str(tmp_path / "pool")
+    sim = SpotPoolSimulator(pool, 8, [PoolEvent(4, 4), PoolEvent(9, 16)])
+    assert sim.read_pool() == 8
+    assert sim.child_faults() == {"sigkill_at_step": 4}
+    assert sim.on_child_exit(0) is None  # clean exit never advances
+    assert sim.read_pool() == 8
+    ev = sim.on_child_exit(137)
+    assert ev is not None and ev.pool_after == 4
+    assert sim.read_pool() == 4
+    assert sim.child_faults() == {"sigkill_at_step": 9}
+    assert sim.on_child_exit(137).pool_after == 16
+    assert sim.read_pool() == 16
+    assert sim.child_faults() is None  # schedule drained
+    assert sim.on_child_exit(137) is None
+    assert len(sim.transitions) == 2
+    with pytest.raises(ValueError):
+        PoolEvent(0, 4)
+    with pytest.raises(ValueError):
+        PoolEvent(4, 0)
+
+
+def test_corrupt_tag_fallback_counter_and_instant(tmp_path):
+    """A truncate/bitflip-corrupt newest tag is skipped at load: the
+    fallback lands on the older valid tag, bumps the
+    resilience_corrupt_tags counter, and drops a trace instant naming
+    the skipped tag."""
+    from deeperspeed_tpu.monitor import (
+        get_monitor, init_monitor, shutdown_monitor,
+    )
+
+    init_monitor({})
+    try:
+        engine = _engine(resilience={"async_save": False,
+                                     "preemption_guard": False})
+        engine.train_batch(batch=_batch(0))
+        engine.save_checkpoint(str(tmp_path))
+        engine.train_batch(batch=_batch(1))
+        engine.save_checkpoint(str(tmp_path))
+        victim = str(tmp_path / "global_step2"
+                     / "mp_rank_00_model_states.msgpack")
+        corrupt_file(victim, "truncate")
+        corrupt_file(victim, "bitflip")
+        fresh = _engine(seed=1)
+        path, _ = fresh.load_checkpoint(str(tmp_path))
+        assert path is not None and path.endswith("global_step1")
+        mon = get_monitor()
+        assert mon.registry.counter("resilience_corrupt_tags").value == 1
+        instants = [e for e in mon.tracer.events()
+                    if e.get("name") == "resilience/corrupt_tag"]
+        assert instants and instants[0]["args"]["tag"] == "global_step2"
+    finally:
+        shutdown_monitor(save=False)
+
+
+def test_prune_never_drops_resumed_or_newest_tag(tmp_path):
+    """Prune-while-resuming regression: with keep_last=1 the tag this
+    run resumed FROM and the newest committed tag must both survive
+    pruning, even when neither is what `latest` points at."""
+    engine = _engine(resilience={"async_save": False,
+                                 "preemption_guard": False,
+                                 "keep_last": 1})
+    for i in range(3):
+        engine.train_batch(batch=_batch(i))
+        engine.save_checkpoint(str(tmp_path))
+    # prune already ran at each save: keep_last=1 retains the newest
+    tags = sorted(p.name for p in tmp_path.iterdir() if p.is_dir())
+    assert tags == ["global_step3"]
+    # a fresh run resumes from step3, then saves twice more: the
+    # resumed-from tag must survive both prunes
+    fresh = _engine(seed=1)
+    path, _ = fresh.load_checkpoint(str(tmp_path))
+    assert path is not None and path.endswith("global_step3")
+    for i in range(3, 5):
+        fresh.train_batch(batch=_batch(i))
+        fresh.save_checkpoint(str(tmp_path))
+    tags = sorted(p.name for p in tmp_path.iterdir() if p.is_dir())
+    assert "global_step3" in tags, "resumed-from tag was pruned mid-run"
+    assert "global_step5" in tags, "newest committed tag was pruned"
+
+
+def test_restart_context_counter(monkeypatch):
+    """A supervisor-restarted child records the restart + reason +
+    chosen world size through the resilience manager's telemetry."""
+    from deeperspeed_tpu.monitor import (
+        get_monitor, init_monitor, shutdown_monitor,
+    )
+    from deeperspeed_tpu.resilience import ResilienceConfig
+    from deeperspeed_tpu.resilience.manager import ResilienceManager
+
+    monkeypatch.setenv("DS_TPU_RESTART_COUNT", "2")
+    monkeypatch.setenv("DS_TPU_RESTART_REASON", "preemption")
+    monkeypatch.setenv("DS_TPU_WORLD_SIZE", "4")
+    init_monitor({})
+    try:
+        mgr = ResilienceManager(ResilienceConfig.from_dict(
+            {"async_save": False, "preemption_guard": False}))
+        mgr.note_restart_context()
+        mgr.note_restart_context()  # idempotent per process
+        mon = get_monitor()
+        assert mon.registry.counter("resilience_restarts").value == 1
+        instants = [e for e in mon.tracer.events()
+                    if e.get("name") == "resilience/restart"]
+        assert len(instants) == 1
+        assert instants[0]["args"] == {
+            "count": 2, "reason": "preemption", "world_size": 4}
+        mgr.close()
+    finally:
+        shutdown_monitor(save=False)
