@@ -10,7 +10,12 @@ fails in CI instead of rendering as an empty timeline:
     non-negative ``dur``;
   * ``"B"``/``"E"`` pairs balance per ``(pid, tid)`` track with proper
     LIFO nesting (an ``E`` must close the innermost open ``B`` of the
-    same name).
+    same name);
+  * named events with a registered arg schema (the serving fleet's
+    ``serving/finish`` / ``serving/shed`` / ``serving/retry`` /
+    ``serving/replica_down`` instants) carry their required args — a
+    drill trace missing the rid/reason fields the zero-loss audit keys
+    on fails here, not in a dashboard.
 
 Used two ways: as a library (``validate_events`` / ``validate_file``,
 the pytest round-trips a generated trace through it) and as a CLI::
@@ -29,6 +34,17 @@ __all__ = ["validate_events", "validate_file", "main"]
 # phases from the Trace Event Format spec; "M" (metadata) and "C"
 # (counter) are what the tracer emits beyond spans/instants
 KNOWN_PHASES = set("BEXiICMPSTFsftbenO(N)D{}v")
+
+# named-event arg schemas: when an event with one of these names appears,
+# its "args" object must carry the listed keys. These are the events the
+# fleet drill's zero-request-loss audit and the retry/shed accounting
+# join on, so a rename or dropped field breaks CI, not the postmortem.
+EVENT_ARG_SCHEMAS = {
+    "serving/finish": ("rid", "reason"),
+    "serving/shed": ("rid", "retry_after_s"),
+    "serving/retry": ("rid", "attempt", "replica"),
+    "serving/replica_down": ("replica", "cause", "inflight"),
+}
 
 _NUM = (int, float)
 
@@ -71,6 +87,17 @@ def validate_events(events) -> List[str]:
         elif not _is_num(ts) or ts < 0:
             errors.append(f"{where} (ph={ph}): 'ts' must be a "
                           f"non-negative number, got {ts!r}")
+        schema = EVENT_ARG_SCHEMAS.get(ev.get("name"))
+        if schema is not None:
+            args = ev.get("args")
+            if not isinstance(args, dict):
+                errors.append(f"{where}: {ev.get('name')!r} requires an "
+                              f"'args' object with {sorted(schema)}")
+            else:
+                missing = [k for k in schema if k not in args]
+                if missing:
+                    errors.append(f"{where}: {ev.get('name')!r} args "
+                                  f"missing {missing}")
         if ph == "X":
             dur = ev.get("dur")
             if dur is None:

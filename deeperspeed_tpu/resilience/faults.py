@@ -24,6 +24,19 @@ Supported faults:
     firing. Lets a supervisor restart the SAME command line and have
     the second run proceed cleanly.
 
+Serving-replica faults (fired from ``on_decode_step``, which a serving
+replica worker calls once per engine step — the fleet drill's knobs):
+
+  * ``replica_sigkill_at_decode: N`` — SIGKILL the replica process at
+    its N-th decode step (mid-stream death; the router must requeue
+    the replica's in-flight requests).
+  * ``replica_stall_at_decode: N``  — from the N-th decode step on,
+    ``on_decode_step`` returns ``"stall"`` and the worker stops
+    stepping its engine while still heartbeating (a wedged-but-alive
+    replica; the router's progress watchdog must catch it).
+  * ``replica_slow_ms: K``          — sleep K ms inside every decode
+    step (degraded replica for brownout drills).
+
 Everything is deterministic — counters, not probabilities — so drills
 are reproducible bit-for-bit.
 """
@@ -32,6 +45,7 @@ import dataclasses
 import json
 import os
 import signal
+import time
 from typing import List, Optional, Sequence
 
 from ..utils.logging import logger
@@ -52,9 +66,15 @@ class FaultPlan:
     sigkill_mid_save: Optional[int] = None
     corrupt_after_save: Optional[str] = None
     flag_file: Optional[str] = None
+    # serving-replica faults (see module docstring)
+    replica_sigkill_at_decode: Optional[int] = None
+    replica_stall_at_decode: Optional[int] = None
+    replica_slow_ms: Optional[int] = None
 
     def __post_init__(self):
-        for key in ("raise_at_step", "sigkill_at_step", "sigkill_mid_save"):
+        for key in ("raise_at_step", "sigkill_at_step", "sigkill_mid_save",
+                    "replica_sigkill_at_decode", "replica_stall_at_decode",
+                    "replica_slow_ms"):
             v = getattr(self, key)
             if v is not None and int(v) < 1:
                 raise ValueError(f"{key} must be >= 1, got {v}")
@@ -167,6 +187,33 @@ class FaultInjector:
                 and global_step == self.plan.raise_at_step):
             self._latch()
             raise InjectedFault(f"injected fault at step {global_step}")
+
+    def on_decode_step(self, decode_step: int) -> Optional[str]:
+        """Serving-replica trigger point, called by the replica worker
+        once per engine step (1-based). Returns ``"stall"`` when the
+        worker should stop stepping its engine (but keep heartbeating);
+        ``replica_slow_ms`` sleeps here; ``replica_sigkill_at_decode``
+        does not return."""
+        if not self.armed:
+            return None
+        if self.plan.replica_slow_ms is not None:
+            time.sleep(self.plan.replica_slow_ms / 1000.0)
+        if self._latched_out():
+            return None
+        if (self.plan.replica_sigkill_at_decode is not None
+                and decode_step >= self.plan.replica_sigkill_at_decode):
+            logger.warning("fault: replica SIGKILL at decode step %d",
+                           decode_step)
+            self._latch()
+            _sigkill()
+        if (self.plan.replica_stall_at_decode is not None
+                and decode_step >= self.plan.replica_stall_at_decode):
+            # the caller keeps the wedge for the life of this process (a
+            # stall is not a blip); the flag-file latch only stops a
+            # RESTARTED replica from wedging again
+            self._latch()
+            return "stall"
+        return None
 
     def on_save_file_written(self, path: str) -> None:
         """Called after each checkpoint payload file is written (still in

@@ -4,17 +4,38 @@ roadmap's north star).
 ``ServingEngine`` turns concurrent requests into efficient fixed-shape
 decode batches over a slot pool backed by a paged KV cache;
 ``PipelineServingBridge`` exposes the same surface over
-``PipelineEngine.inference_batch`` for pipelined models. See
-docs/tutorials/serving.md for the walkthrough.
+``PipelineEngine.inference_batch`` for pipelined models. On top of
+single engines, the fleet layer (``FleetRouter`` over
+``ThreadReplica``/``SubprocessReplica`` workers) adds admission
+control, wall-clock deadlines, health-checked failover, and rolling
+restarts. See docs/tutorials/serving.md for the walkthrough.
 """
 
-from .config import ServingConfig
-from .engine import PipelineServingBridge, ServingEngine, make_decode_step
+from .config import RouterConfig, ServingConfig
+from .engine import (
+    EngineDrainingError,
+    PipelineServingBridge,
+    ServingEngine,
+    derive_request_seed,
+    make_decode_step,
+    request_sample_key,
+)
+from .fleet import (
+    ReplicaUnavailableError,
+    SubprocessReplica,
+    ThreadReplica,
+    build_subprocess_fleet,
+    build_thread_fleet,
+)
 from .kv_cache import BlockAllocator, PagedKVCache, blocks_needed
-from .metrics import ServingMetrics
+from .metrics import FleetMetrics, ServingMetrics
+from .router import FleetRouter, RouterRequest, ShedError
 from .scheduler import (
     FINISH_EOS,
+    FINISH_FAILED,
     FINISH_LENGTH,
+    FINISH_RETRIED,
+    FINISH_SHED,
     FINISH_TIMEOUT,
     Request,
     Scheduler,
@@ -22,16 +43,32 @@ from .scheduler import (
 
 __all__ = [
     "ServingConfig",
+    "RouterConfig",
     "ServingEngine",
     "PipelineServingBridge",
+    "EngineDrainingError",
     "make_decode_step",
+    "derive_request_seed",
+    "request_sample_key",
     "BlockAllocator",
     "PagedKVCache",
     "blocks_needed",
     "ServingMetrics",
+    "FleetMetrics",
     "Scheduler",
     "Request",
+    "FleetRouter",
+    "RouterRequest",
+    "ShedError",
+    "ThreadReplica",
+    "SubprocessReplica",
+    "ReplicaUnavailableError",
+    "build_thread_fleet",
+    "build_subprocess_fleet",
     "FINISH_EOS",
     "FINISH_LENGTH",
     "FINISH_TIMEOUT",
+    "FINISH_SHED",
+    "FINISH_RETRIED",
+    "FINISH_FAILED",
 ]

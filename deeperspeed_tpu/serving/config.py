@@ -27,8 +27,95 @@ from typing import Optional, Tuple
 _KNOWN_KEYS = frozenset({
     "enabled", "num_slots", "block_size", "num_blocks", "max_seq_len",
     "max_new_tokens", "eos_token_id", "top_k", "request_timeout_s",
-    "prefill_buckets", "seed",
+    "prefill_buckets", "seed", "fleet",
 })
+
+_ROUTER_KNOWN_KEYS = frozenset({
+    "num_replicas", "max_queue_depth", "max_inflight_tokens",
+    "default_deadline_s", "retry_max", "retry_backoff_base_s",
+    "retry_backoff_max_s", "heartbeat_timeout_s", "progress_timeout_s",
+    "replica_restart", "replica_max_restarts", "poll_interval_s",
+})
+
+
+@dataclasses.dataclass(frozen=True)
+class RouterConfig:
+    """The ``"fleet"`` sub-block of the serving config: the front-end
+    router's admission, deadline, retry, and health policy
+    (serving/router.py). Every limit is explicit — the router sheds
+    rather than queue unboundedly, and a replica that stops heartbeating
+    or stops emitting tokens is failed over, not waited on."""
+
+    # replicas the fleet builder spawns (a pre-built replica list wins)
+    num_replicas: int = 2
+    # admission control: accepted-but-unfinished request cap ...
+    max_queue_depth: int = 64
+    # ... and in-flight token budget (sum of prompt + max_new_tokens
+    # over accepted requests); None disables the token gate
+    max_inflight_tokens: Optional[int] = None
+    # wall-clock budget per request, checked AT THE ROUTER (distinct
+    # from the engine's progress-based request_timeout_s); submit may
+    # override per request; None = no deadline
+    default_deadline_s: Optional[float] = None
+    # bounded failover: re-dispatches allowed per request after replica
+    # failures, with exponential backoff between attempts
+    retry_max: int = 2
+    retry_backoff_base_s: float = 0.05
+    retry_backoff_max_s: float = 2.0
+    # health watchdogs: a replica is DEAD when its heartbeat is older
+    # than this ...
+    heartbeat_timeout_s: float = 10.0
+    # ... and STALLED when it holds in-flight work but its decode
+    # progress counter has not moved for this long
+    progress_timeout_s: float = 30.0
+    # lifecycle: restart failed replicas (supervisor-style backoff),
+    # capped per replica
+    replica_restart: bool = True
+    replica_max_restarts: int = 2
+    # router run()/drive loop sleep when idle
+    poll_interval_s: float = 0.01
+
+    def __post_init__(self):
+        if self.num_replicas < 1:
+            raise ValueError(
+                f"num_replicas must be >= 1, got {self.num_replicas}")
+        if self.max_queue_depth < 1:
+            raise ValueError(
+                f"max_queue_depth must be >= 1, got {self.max_queue_depth}")
+        if (self.max_inflight_tokens is not None
+                and self.max_inflight_tokens < 1):
+            raise ValueError(
+                f"max_inflight_tokens must be >= 1 or None, got "
+                f"{self.max_inflight_tokens}")
+        if (self.default_deadline_s is not None
+                and self.default_deadline_s <= 0):
+            raise ValueError(
+                f"default_deadline_s must be > 0 or None, got "
+                f"{self.default_deadline_s}")
+        if self.retry_max < 0:
+            raise ValueError(
+                f"retry_max must be >= 0, got {self.retry_max}")
+        for key in ("retry_backoff_base_s", "retry_backoff_max_s",
+                    "heartbeat_timeout_s", "progress_timeout_s",
+                    "poll_interval_s"):
+            if getattr(self, key) <= 0:
+                raise ValueError(
+                    f"{key} must be > 0, got {getattr(self, key)}")
+        if self.replica_max_restarts < 0:
+            raise ValueError(
+                f"replica_max_restarts must be >= 0, got "
+                f"{self.replica_max_restarts}")
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "RouterConfig":
+        if d is None:
+            return cls()
+        unknown = set(d) - _ROUTER_KNOWN_KEYS
+        if unknown:
+            raise ValueError(
+                f"unknown fleet config keys {sorted(unknown)}; known keys "
+                f"are {sorted(_ROUTER_KNOWN_KEYS)}")
+        return cls(**d)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -53,10 +140,16 @@ class ServingConfig:
     request_timeout_s: Optional[float] = None
     # prefill length buckets; () derives doubling multiples of block_size
     prefill_buckets: Tuple[int, ...] = ()
-    # base PRNG seed for sampled slots
+    # base PRNG seed for sampled slots (per-request seeds derive from it)
     seed: int = 0
+    # multi-replica front-end router policy (serving/router.py); None =
+    # single-engine serving, no fleet layer
+    fleet: Optional[RouterConfig] = None
 
     def __post_init__(self):
+        if isinstance(self.fleet, dict):
+            object.__setattr__(self, "fleet",
+                               RouterConfig.from_dict(self.fleet))
         if self.num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {self.num_slots}")
         if self.block_size < 1:

@@ -37,6 +37,7 @@ pipelined KV caching lands.
 
 import itertools
 import time
+import zlib
 from functools import partial
 from typing import Dict, List, Optional, Sequence, Union
 
@@ -54,6 +55,36 @@ from .config import ServingConfig
 from .kv_cache import PagedKVCache, blocks_needed, paged_attend
 from .metrics import DECODE_TIMER, PREFILL_TIMER, ServingMetrics
 from .scheduler import Request, Scheduler
+
+
+class EngineDrainingError(RuntimeError):
+    """Raised by ``submit()`` while the engine is draining: it is
+    finishing its in-flight requests and admits nothing new. Callers
+    owning more than one engine (the fleet router) catch this and fail
+    the request over to another replica instead of stranding it in a
+    queue that will never be served."""
+
+
+# ------------------------------------------------------------------ #
+# deterministic per-request sampling
+# ------------------------------------------------------------------ #
+
+
+def derive_request_seed(base_seed: int, rid: str) -> int:
+    """Stable per-request sampling seed: a pure function of the engine
+    seed and the request id (crc32, NOT Python hash(), which is
+    randomized per process) so every replica — and every retry of the
+    same rid on a different replica — derives the same stream."""
+    return (zlib.crc32(rid.encode("utf-8")) ^ (base_seed * 0x9E3779B1)) \
+        & 0x7FFFFFFF
+
+
+def request_sample_key(seed: int, count: int):
+    """PRNG key for a request's ``count``-th sampled token. Sampling is
+    a pure function of (seed, token index): no engine-global key stream,
+    so a retried request replays token-identically anywhere."""
+    key = jax.random.fold_in(jax.random.PRNGKey(0), seed)
+    return jax.random.fold_in(key, count)
 
 
 # ------------------------------------------------------------------ #
@@ -92,10 +123,13 @@ def make_decode_step(cfg: GPTConfig, scfg: ServingConfig):
     """Build the jitted all-slots decode step.
 
     decode_step(params, k_pool, v_pool, tables, lengths, tokens, temps,
-    rng) -> (next_tokens (N,), k_pool', v_pool'). Pools are donated —
-    the caller's old handles die each step (no second pool in HBM).
-    temps[i] <= 0 selects greedy argmax for slot i; > 0 samples at that
-    temperature under the config's static top_k.
+    seeds, counts) -> (next_tokens (N,), k_pool', v_pool'). Pools are
+    donated — the caller's old handles die each step (no second pool in
+    HBM). temps[i] <= 0 selects greedy argmax for slot i; > 0 samples at
+    that temperature under the config's static top_k, keyed by
+    ``request_sample_key(seeds[i], counts[i])`` so the sampled stream is
+    a pure per-request function — retries and cross-replica failovers
+    replay it token-identically.
     """
     top_k = scfg.top_k
     if top_k is not None and top_k >= cfg.vocab_size:
@@ -103,7 +137,7 @@ def make_decode_step(cfg: GPTConfig, scfg: ServingConfig):
 
     @partial(jax.jit, donate_argnums=(1, 2))
     def decode_step(params, k_pool, v_pool, tables, lengths, tokens,
-                    temps, rng):
+                    temps, seeds, counts):
         cdt = cfg.dtype
         N = tokens.shape[0]
         wte = params["embed"]["wte"].astype(cdt)
@@ -139,8 +173,10 @@ def make_decode_step(cfg: GPTConfig, scfg: ServingConfig):
         if top_k is not None:
             kth = jax.lax.top_k(l32, top_k)[0][..., -1:]
             l32 = jnp.where(l32 < kth, -1e30, l32)
-        sampled = jax.random.categorical(rng, l32, axis=-1).astype(
-            jnp.int32)
+        keys = jax.vmap(request_sample_key)(seeds, counts)
+        sampled = jax.vmap(
+            lambda k, row: jax.random.categorical(k, row)
+        )(keys, l32).astype(jnp.int32)
         nxt = jnp.where(temps > 0.0, sampled, greedy)
         return nxt, k_new, v_new
 
@@ -189,10 +225,17 @@ class _ServingBase:
                max_new_tokens: Optional[int] = None,
                temperature: float = 0.0,
                request_id: Optional[str] = None,
-               arrival_t: Optional[float] = None) -> str:
+               arrival_t: Optional[float] = None,
+               seed: Optional[int] = None) -> str:
         """Queue one request; returns its id. Raises when the request
-        could never fit (context cap / pool footprint) — everything else
-        is handled by scheduling, not by the caller."""
+        could never fit (context cap / pool footprint) or while the
+        engine is draining (``EngineDrainingError`` — the caller must
+        fail over, not wait) — everything else is handled by scheduling,
+        not by the caller."""
+        if self._draining:
+            raise EngineDrainingError(
+                "engine is draining (preemption/restart in progress); "
+                "admits nothing new — resubmit on another replica")
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         rid = request_id if request_id is not None else \
             f"req-{next(self._rid_counter)}"
@@ -205,6 +248,8 @@ class _ServingBase:
                             if max_new_tokens is None else max_new_tokens),
             temperature=float(temperature),
             arrival_t=self.clock() if arrival_t is None else arrival_t,
+            seed=(derive_request_seed(self.scfg.seed, rid)
+                  if seed is None else int(seed)),
         )
         self.sched.submit(req)
         self._requests[rid] = req
@@ -215,6 +260,18 @@ class _ServingBase:
 
     def has_work(self) -> bool:
         return self.sched.has_work()
+
+    def cancel(self, rid: str, reason: str = "timeout") -> bool:
+        """Terminate one request wherever it is (queued or active),
+        releasing its slot/blocks; partial output is kept. Returns False
+        when the rid is unknown or already finished. The router's
+        deadline enforcement lands here."""
+        req = self._requests.get(rid)
+        if req is None or req.state == "finished":
+            return False
+        self.sched.finish(req, reason)
+        self.metrics.record_finish(req, self.clock())
+        return True
 
     # -- the scheduler loop ------------------------------------------- #
 
@@ -269,6 +326,7 @@ class _ServingBase:
 
     def _record_emitted(self, req: Request, prefill: bool) -> None:
         now = self.clock()
+        req.last_token_t = now    # progress clock for expire_timeouts
         if prefill:
             ttft = None
             if req.first_token_t is None:
@@ -304,7 +362,6 @@ class ServingEngine(_ServingBase):
             lambda params, toks: apply_with_cache(
                 cfg, params, toks,
                 init_cache(cfg, toks.shape[0], toks.shape[1]), 0))
-        self._key = jax.random.PRNGKey(scfg.seed)
         if self.telemetry is not None:
             # decode must stay one-compile forever; prefill legitimately
             # retraces per length bucket, so it is deliberately unwatched
@@ -320,13 +377,11 @@ class ServingEngine(_ServingBase):
     def prefill_compile_count(self) -> int:
         return getattr(self._prefill_step, "_cache_size", lambda: -1)()
 
-    def _next_key(self):
-        self._key, sub = jax.random.split(self._key)
-        return sub
-
     def _pick_token(self, logits_1d, req: Request) -> int:
         """Prefill-time next-token selection (one request, host-driven).
-        Greedy path is the same raw argmax make_generator uses."""
+        Greedy path is the same raw argmax make_generator uses; sampling
+        keys off (req.seed, token index) exactly like the decode step,
+        so a re-prefill after preemption or retry replays the stream."""
         if req.temperature <= 0.0:
             return int(jnp.argmax(logits_1d))
         top_k = self.scfg.top_k
@@ -334,8 +389,8 @@ class ServingEngine(_ServingBase):
             top_k = None
         filtered = prep_sampling_logits(logits_1d[None], req.temperature,
                                         top_k)
-        return int(jax.random.categorical(self._next_key(), filtered,
-                                          axis=-1)[0])
+        key = request_sample_key(req.seed, len(req.generated))
+        return int(jax.random.categorical(key, filtered, axis=-1)[0])
 
     def _admit_one(self, slot: int, req: Request, blocks: List[int]) -> None:
         """Length-bucketed prefill of the request's context into its
@@ -369,6 +424,8 @@ class ServingEngine(_ServingBase):
         lengths = np.zeros(N, np.int32)
         tokens = np.zeros(N, np.int32)
         temps = np.zeros(N, np.float32)
+        seeds = np.zeros(N, np.int32)
+        counts = np.zeros(N, np.int32)
         active = []
         for s, req in enumerate(self.sched.slots):
             if req is None:
@@ -378,6 +435,8 @@ class ServingEngine(_ServingBase):
             lengths[s] = req.cached_len
             tokens[s] = req.pending_token
             temps[s] = req.temperature
+            seeds[s] = req.seed
+            counts[s] = len(req.generated)
         with trace_span("serving/decode", lane="serving",
                         n_active=len(active)):
             timer = self.metrics.timers(DECODE_TIMER)
@@ -385,7 +444,8 @@ class ServingEngine(_ServingBase):
             nxt, self.kv.k, self.kv.v = self._decode_step(
                 self.params, self.kv.k, self.kv.v, jnp.asarray(tables),
                 jnp.asarray(lengths), jnp.asarray(tokens),
-                jnp.asarray(temps), self._next_key())
+                jnp.asarray(temps), jnp.asarray(seeds),
+                jnp.asarray(counts))
             nxt = np.asarray(nxt)                   # device sync
             timer.stop()
         if self.telemetry is not None:
@@ -429,7 +489,6 @@ class PipelineServingBridge(_ServingBase):
         alloc = BlockAllocator(1 + scfg.num_slots * scfg.blocks_per_slot)
         super().__init__(scfg, Scheduler(scfg, alloc, clock), clock,
                          monitor, monitor_config)
-        self._key = jax.random.PRNGKey(scfg.seed)
 
     @classmethod
     def from_pipeline_engine(cls, engine, serving_config=None, **kw):
@@ -443,8 +502,8 @@ class PipelineServingBridge(_ServingBase):
         top_k = self.scfg.top_k
         filtered = prep_sampling_logits(jnp.asarray(logits_1d)[None],
                                         req.temperature, top_k)
-        self._key, sub = jax.random.split(self._key)
-        return int(jax.random.categorical(sub, filtered, axis=-1)[0])
+        key = request_sample_key(req.seed, len(req.generated))
+        return int(jax.random.categorical(key, filtered, axis=-1)[0])
 
     def _emit_next(self, req: Request, prefill: bool) -> None:
         ctx = np.asarray(req.context, np.int32)[None]

@@ -22,6 +22,23 @@ PREFILL_TIMER = "serving/prefill"
 DECODE_TIMER = "serving/decode"
 
 
+def record_finish_outcome(registry: Optional[MetricsRegistry],
+                          reason: str) -> None:
+    """Bump the labeled per-attempt outcome counter. The label space is
+    the union of engine finish reasons (``length``/``eos``/``timeout``)
+    and router outcomes (``shed``/``retried``/``failed``), so one
+    ``serving_finish_total`` series tells the whole admission-to-finish
+    story; no-op without a registry."""
+    if registry is None:
+        return
+    registry.counter(
+        "serving_finish_total",
+        "Per-attempt request outcomes (engine evictions + router "
+        "shed/retry/failover), labeled by reason.",
+        labels={"reason": str(reason)},
+    ).inc()
+
+
 def _percentiles(xs: List[float]) -> Dict[str, float]:
     if not xs:
         return {"p50": 0.0, "p99": 0.0, "mean": 0.0, "max": 0.0}
@@ -139,6 +156,10 @@ class ServingMetrics:
                 "Finished requests by terminal reason.",
                 labels={"reason": str(req.finish_reason)},
             ).inc()
+            # one label space shared with the router layer, so engine
+            # evictions and router outcomes (shed/retried/failed) land
+            # in the same serving_finish_total series
+            record_finish_outcome(self.registry, req.finish_reason)
             if tpot is not None:
                 self._h_tpot.observe(tpot)
 
@@ -190,3 +211,134 @@ class ServingMetrics:
             },
             step,
         )
+
+
+class FleetMetrics:
+    """Router-side accounting: accepted/shed/retried counts, replica
+    health transitions, and router-observed TTFT/E2E latencies (clocked
+    from router accept to the event arriving back at the router, so a
+    retry's re-prefill time is IN the number — this is the latency a
+    client actually sees under failure).
+
+    Same split as ServingMetrics: host-side lists for ``summary()``,
+    plus registry counters/gauges when a monitor/ registry is present.
+    """
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic,
+                 registry: Optional[MetricsRegistry] = None):
+        self.clock = clock
+        self.registry = registry
+        self.accepted = 0
+        self.shed = 0
+        self.retries = 0
+        self.replica_downs: List[Dict] = []
+        self.outcomes: Dict[str, int] = {}
+        self.ttft_s: List[float] = []
+        self.e2e_s: List[float] = []
+        if registry is not None:
+            self._c_accepted = registry.counter(
+                "serving_router_accepted_total",
+                "Requests accepted by router admission control.")
+            self._c_shed = registry.counter(
+                "serving_shed_total",
+                "Requests rejected by admission control (overload).")
+            self._c_retry = registry.counter(
+                "serving_retries_total",
+                "Request re-dispatches after replica failures.")
+            self._h_ttft = registry.histogram(
+                "serving_router_ttft_seconds",
+                "Router-observed time to first token (includes retry "
+                "re-prefills).", buckets=DEFAULT_LATENCY_BUCKETS)
+            self._h_e2e = registry.histogram(
+                "serving_router_e2e_seconds",
+                "Router-observed accept-to-terminal latency.",
+                buckets=DEFAULT_LATENCY_BUCKETS)
+
+    # ------------------------------------------------------------ #
+
+    def record_accept(self) -> None:
+        self.accepted += 1
+        if self.registry is not None:
+            self._c_accepted.inc()
+
+    def record_shed(self) -> None:
+        self.shed += 1
+        if self.registry is not None:
+            self._c_shed.inc()
+        record_finish_outcome(self.registry, "shed")
+
+    def record_retry(self) -> None:
+        self.retries += 1
+        if self.registry is not None:
+            self._c_retry.inc()
+        record_finish_outcome(self.registry, "retried")
+
+    def record_replica_down(self, name: str, cause: str,
+                            inflight: int) -> None:
+        self.replica_downs.append(
+            {"replica": name, "cause": cause, "inflight": inflight,
+             "t": self.clock()})
+        if self.registry is not None:
+            self.registry.counter(
+                "serving_replica_down_total",
+                "Replicas marked unhealthy, by cause.",
+                labels={"replica": name, "cause": cause},
+            ).inc()
+
+    def record_ttft(self, ttft: float) -> None:
+        self.ttft_s.append(ttft)
+        if self.registry is not None:
+            self._h_ttft.observe(ttft)
+
+    def record_outcome(self, reason: str,
+                       e2e_s: Optional[float] = None) -> None:
+        """Terminal outcome for an ACCEPTED request (finish reasons plus
+        router-level timeout/failed); shed requests were never accepted
+        and are counted by record_shed."""
+        self.outcomes[reason] = self.outcomes.get(reason, 0) + 1
+        if e2e_s is not None:
+            self.e2e_s.append(e2e_s)
+            if self.registry is not None:
+                self._h_e2e.observe(e2e_s)
+        record_finish_outcome(self.registry, reason)
+
+    def set_replica_gauges(self, name: str, healthy: bool,
+                           inflight: int) -> None:
+        if self.registry is None:
+            return
+        self.registry.gauge(
+            "serving_replica_healthy",
+            "1 while the replica passes both watchdogs, else 0.",
+            labels={"replica": name}).set(1.0 if healthy else 0.0)
+        self.registry.gauge(
+            "serving_replica_inflight",
+            "Requests currently dispatched to the replica.",
+            labels={"replica": name}).set(float(inflight))
+
+    def set_load_gauges(self, queue_depth: int,
+                        inflight_tokens: int) -> None:
+        if self.registry is None:
+            return
+        self.registry.gauge(
+            "serving_fleet_queue_depth",
+            "Accepted-but-unfinished requests at the router.",
+        ).set(float(queue_depth))
+        self.registry.gauge(
+            "serving_fleet_inflight_tokens",
+            "Token budget in flight (sum of prompt + max_new_tokens).",
+        ).set(float(inflight_tokens))
+
+    # ------------------------------------------------------------ #
+
+    def summary(self) -> Dict:
+        offered = self.accepted + self.shed
+        return {
+            "accepted": self.accepted,
+            "shed": self.shed,
+            "shed_rate": self.shed / offered if offered else 0.0,
+            "retries": self.retries,
+            "replica_downs": list(self.replica_downs),
+            "outcomes": dict(self.outcomes),
+            "router_ttft_s": _percentiles(self.ttft_s),
+            "router_e2e_s": _percentiles(self.e2e_s),
+        }

@@ -41,6 +41,11 @@ FINISHED = "finished"
 FINISH_LENGTH = "length"      # exhausted max_new_tokens
 FINISH_EOS = "eos"
 FINISH_TIMEOUT = "timeout"
+# router-layer outcomes (serving/router.py) — kept here so every finish
+# reason shares one namespace and one serving_finish_total label set
+FINISH_SHED = "shed"          # rejected at admission (overload)
+FINISH_RETRIED = "retried"    # attempt lost to a replica failure; requeued
+FINISH_FAILED = "failed"      # retry budget exhausted
 
 
 @dataclasses.dataclass
@@ -50,6 +55,10 @@ class Request:
     max_new_tokens: int
     temperature: float = 0.0
     arrival_t: float = 0.0
+    # per-request sampling seed: sampled tokens are a pure function of
+    # (seed, token index), so a retried request replays its exact stream
+    # on any replica; None = derive from (engine seed, rid) at submit
+    seed: Optional[int] = None
     # -- runtime state --
     state: str = QUEUED
     generated: List[int] = dataclasses.field(default_factory=list)
@@ -57,6 +66,7 @@ class Request:
     cached_len: int = 0           # tokens whose KV is written to the pool
     admissions: int = 0           # 1 + number of preemption re-admissions
     first_token_t: Optional[float] = None
+    last_token_t: Optional[float] = None   # progress clock for timeouts
     finish_t: Optional[float] = None
     finish_reason: Optional[str] = None
 
@@ -255,12 +265,22 @@ class Scheduler:
         return False
 
     def expire_timeouts(self, now: float) -> List[Request]:
-        """Evict queued AND active requests older than request_timeout_s."""
+        """Evict requests that made no progress for request_timeout_s.
+
+        Progress-based, not age-based: an ACTIVE request emitting tokens
+        at a steady clip never expires here no matter how long it runs —
+        wall-clock deadlines are the router layer's job
+        (serving/router.py). A queued request never progresses, so for it
+        this degenerates to time-since-arrival, which keeps the original
+        stuck-in-queue eviction semantics."""
         timeout = self.scfg.request_timeout_s
         if timeout is None:
             return []
-        expired = [r for r in list(self.queue) + self.active
-                   if now - r.arrival_t >= timeout]
+        expired = [
+            r for r in list(self.queue) + self.active
+            if now - (r.last_token_t if r.last_token_t is not None
+                      else r.arrival_t) >= timeout
+        ]
         for r in expired:
             self.finish(r, FINISH_TIMEOUT, now)
         return expired

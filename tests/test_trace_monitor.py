@@ -313,7 +313,8 @@ def test_watchdog_silent_across_serving_run_then_fires_on_injection():
         eng.params, jnp.array(eng.kv.k), jnp.array(eng.kv.v),
         jnp.zeros((n2, eng.scfg.blocks_per_slot), jnp.int32),
         jnp.zeros(n2, jnp.int32), jnp.zeros(n2, jnp.int32),
-        jnp.zeros(n2, jnp.float32), jax.random.PRNGKey(0))
+        jnp.zeros(n2, jnp.float32), jnp.zeros(n2, jnp.int32),
+        jnp.zeros(n2, jnp.int32))
     assert eng.telemetry.watchdog.observe() == ["serving/decode_step"]
     assert eng.decode_compile_count == 2
 
