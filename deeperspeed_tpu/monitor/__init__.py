@@ -22,15 +22,18 @@ one ``is None`` check.
 """
 
 import atexit
+import os
 from typing import Optional, Union
 
 from ..utils.logging import logger
 from .config import MonitorConfig
+from .flight import FlightRecorder
 from .metrics import (
     MetricsRegistry,
     MetricsServer,
     export_to_tensorboard,
 )
+from .runctx import RunContext, current as current_run_context, ensure_run_id
 from .tracer import (
     Tracer,
     get_tracer,
@@ -48,8 +51,12 @@ __all__ = [
     "MetricsRegistry",
     "MetricsServer",
     "Tracer",
+    "FlightRecorder",
+    "RunContext",
     "RecompileError",
     "RecompileWatchdog",
+    "current_run_context",
+    "ensure_run_id",
     "export_to_tensorboard",
     "get_monitor",
     "init_monitor",
@@ -71,10 +78,37 @@ class Monitor:
         cfg = (config if isinstance(config, MonitorConfig)
                else MonitorConfig.from_dict(config))
         self.config = cfg
-        self.tracer = (Tracer(ring_size=cfg.ring_size)
-                       if cfg.trace_enabled else None)
-        self.watchdog = RecompileWatchdog(mode=cfg.watchdog)
+        self.run_context = current_run_context()
+        trace_path, flight_path = cfg.trace_path, cfg.flight_path
+        if cfg.obs_dir:
+            # run-scoped layout: one static config serves every
+            # incarnation of every role without files clobbering
+            stem = (f"{self.run_context.role}"
+                    f".i{self.run_context.incarnation}")
+            if trace_path is None:
+                trace_path = os.path.join(cfg.obs_dir,
+                                          f"{stem}.trace.json")
+            if flight_path is None:
+                flight_path = os.path.join(cfg.obs_dir,
+                                           f"{stem}.flight.bin")
+        self.trace_path = trace_path
         self.registry = MetricsRegistry()
+        self.flight: Optional[FlightRecorder] = None
+        if cfg.trace_enabled and flight_path is not None:
+            self.flight = FlightRecorder(
+                flight_path, capacity=cfg.flight_records,
+                slot_bytes=cfg.flight_slot_bytes)
+        if cfg.trace_enabled:
+            dropped = self.registry.counter(
+                "monitor_dropped_events",
+                "Trace events evicted unread by the bounded ring.")
+            self.tracer: Optional[Tracer] = Tracer(
+                ring_size=cfg.ring_size, flight=self.flight,
+                run_context=self.run_context,
+                on_drop=lambda n: dropped.inc(n))
+        else:
+            self.tracer = None
+        self.watchdog = RecompileWatchdog(mode=cfg.watchdog)
         self.metrics_server: Optional[MetricsServer] = None
         if cfg.metrics_port is not None:
             self.metrics_server = MetricsServer(
@@ -99,10 +133,13 @@ class Monitor:
 
     def _atexit_save(self) -> None:
         # crash insurance: the trace survives a run that never reached
-        # shutdown_monitor(); idempotent with an explicit save
+        # shutdown_monitor(); idempotent with an explicit save. (SIGKILL
+        # skips this entirely — that is what the flight recorder is for.)
         try:
-            if self.tracer is not None and self.config.trace_path:
-                self.tracer.save(self.config.trace_path)
+            if self.tracer is not None and self.trace_path:
+                self.tracer.save(self.trace_path)
+            if self.flight is not None:
+                self.flight.flush()
         except Exception:  # pragma: no cover - interpreter teardown
             pass
 
@@ -111,7 +148,7 @@ class Monitor:
         ``trace_path``); returns the path written, or None."""
         if self.tracer is None:
             return None
-        path = path or self.config.trace_path
+        path = path or self.trace_path
         if not path:
             return None
         return self.tracer.save(path)
@@ -128,6 +165,8 @@ class Monitor:
             self.save_trace()
         if self.metrics_server is not None:
             self.metrics_server.close()
+        if self.flight is not None:
+            self.flight.close()
         if self.tracer is not None and get_tracer() is self.tracer:
             set_tracer(self._prev_tracer)
 

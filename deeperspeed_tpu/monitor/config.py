@@ -14,8 +14,18 @@ them, so the hot path pays nothing otherwise.
         "watchdog": "warn",                    # off | warn | strict
         "metrics_port": 9184,                  # null = no endpoint; 0 = ephemeral
         "metrics_host": "127.0.0.1",
-        "tb_export_interval": 0                # steps; 0 = no TB export
+        "tb_export_interval": 0,               # steps; 0 = no TB export
+        "flight_path": "/tmp/flight.bin",      # null = no flight recorder
+        "flight_records": 2048,                # flight ring capacity
+        "flight_slot_bytes": 512,              # fixed record size
+        "obs_dir": null                        # derive per-incarnation paths
     }
+
+``obs_dir`` is the run-scoped form: when set, ``trace_path`` and
+``flight_path`` default to ``<obs_dir>/<role>.i<incarnation>.trace.json``
+/ ``...flight.bin`` (role/incarnation from the DS_TPU_* run context), so
+one static config block works across supervisor restarts and replica
+fleets without incarnations overwriting each other's files.
 """
 
 import dataclasses
@@ -26,6 +36,7 @@ from .watchdog import MODES
 _KNOWN_KEYS = frozenset({
     "enabled", "trace_enabled", "trace_path", "ring_size", "watchdog",
     "metrics_port", "metrics_host", "tb_export_interval",
+    "flight_path", "flight_records", "flight_slot_bytes", "obs_dir",
 })
 
 
@@ -51,10 +62,24 @@ class MonitorConfig:
     # export the metrics registry through TensorBoardMonitor every N
     # steps; 0 disables
     tb_export_interval: int = 0
+    # crash-proof flight recorder (monitor/flight.py): None disables
+    flight_path: Optional[str] = None
+    flight_records: int = 2048
+    flight_slot_bytes: int = 512
+    # run-scoped output directory: derives trace_path/flight_path from
+    # the process's role + incarnation when they are not set explicitly
+    obs_dir: Optional[str] = None
 
     def __post_init__(self):
         if self.ring_size < 1:
             raise ValueError(f"ring_size must be >= 1, got {self.ring_size}")
+        if self.flight_records < 1:
+            raise ValueError(
+                f"flight_records must be >= 1, got {self.flight_records}")
+        if self.flight_slot_bytes < 48:
+            raise ValueError(
+                f"flight_slot_bytes must be >= 48, got "
+                f"{self.flight_slot_bytes}")
         if self.watchdog not in MODES:
             raise ValueError(
                 f"watchdog must be one of {MODES}, got {self.watchdog!r}")

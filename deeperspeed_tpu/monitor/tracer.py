@@ -21,6 +21,23 @@ is the OS pid, ``tid`` is either the real thread id or a named logical
 lane (``lane="serving"``) so Perfetto renders one track per subsystem
 (engine / pipeline stages / offload / serving) instead of interleaving
 everything on the main thread's track.
+
+Two run-scoped extras feed the cross-process story (monitor/aggregate):
+
+  * every tracer snapshots a ``(wall, perf)`` clock anchor at
+    construction and stamps it — with the run context (run_id / role /
+    incarnation, see runctx.py) — into the saved trace's ``otherData``
+    and process metadata, so per-process traces can be rebased onto one
+    shared timeline and labeled per incarnation;
+  * an optional ``flight`` sink (monitor/flight.py) receives every
+    event inline as it is recorded, so a SIGKILLed process still
+    leaves its last events on disk.
+
+Ring eviction is no longer silent: the tracer counts drops, notifies an
+``on_drop`` hook (the Monitor wires it to the ``monitor_dropped_events``
+counter), and emits a rate-limited ``trace/dropped`` instant so the
+timeline itself shows where history was lost; the total also rides in
+the trace footer (``otherData.dropped_events``).
 """
 
 import json
@@ -28,7 +45,9 @@ import os
 import threading
 import time
 from collections import deque
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
+
+from .runctx import RunContext, clock_anchor, current as current_run
 
 __all__ = [
     "Tracer",
@@ -87,7 +106,12 @@ class _Span:
 class Tracer:
     """Thread-safe span/counter/instant recorder with bounded memory."""
 
-    def __init__(self, ring_size: int = 65536, pid: Optional[int] = None):
+    # at most one trace/dropped instant per this many seconds
+    DROP_NOTE_INTERVAL_S = 1.0
+
+    def __init__(self, ring_size: int = 65536, pid: Optional[int] = None,
+                 flight=None, run_context: Optional[RunContext] = None,
+                 on_drop: Optional[Callable[[int], None]] = None):
         if ring_size < 1:
             raise ValueError(f"ring_size must be >= 1, got {ring_size}")
         self.ring_size = ring_size
@@ -96,16 +120,51 @@ class Tracer:
         self._lock = threading.Lock()
         self._lanes: Dict[str, int] = {}
         self.dropped = 0  # events evicted by the ring
+        self.flight = flight            # inline crash-proof sink
+        self.run_context = (run_context if run_context is not None
+                            else current_run())
+        self.on_drop = on_drop
+        self.clock = clock_anchor()     # (wall, perf) for trace merging
+        self._last_drop_note = float("-inf")
 
     # -------------------------------------------------------------- #
     # recording
     # -------------------------------------------------------------- #
 
     def _append(self, ev: dict) -> None:
+        note = None
         with self._lock:
             if len(self._events) == self.ring_size:
-                self.dropped += 1
+                evicted = 1
+                now = time.perf_counter()
+                if now - self._last_drop_note >= self.DROP_NOTE_INTERVAL_S:
+                    self._last_drop_note = now
+                    evicted += 1  # the note itself evicts one more
+                self.dropped += evicted
+                if evicted == 2:
+                    note = {
+                        "name": "trace/dropped",
+                        "ph": "i",
+                        "s": "p",  # process-scoped: loss affects every lane
+                        "ts": now * 1e6,
+                        "pid": self.pid,
+                        "tid": 0,
+                        "args": {"dropped": self.dropped},
+                    }
+                    self._events.append(note)
+                if self.on_drop is not None:
+                    try:
+                        self.on_drop(evicted)
+                    except Exception:  # pragma: no cover - hook is advisory
+                        pass
             self._events.append(ev)
+        if note is not None and self.flight is not None:
+            self.flight.append(note)
+        if self.flight is not None:
+            # inline, outside the ring lock: the flight ring has its
+            # own; this is what makes the record survive a SIGKILL that
+            # lands one instruction later
+            self.flight.append(ev)
 
     def _tid(self, lane: Optional[str]) -> int:
         if lane is None:
@@ -163,12 +222,16 @@ class Tracer:
         """Perfetto display names for the logical lanes."""
         with self._lock:
             lanes = dict(self._lanes)
+        rc = self.run_context
+        proc = "deeperspeed_tpu"
+        if rc is not None and (rc.run_id or rc.role != "main"):
+            proc = f"deeperspeed_tpu:{rc.role}#{rc.incarnation}"
         meta = [{
             "name": "process_name",
             "ph": "M",
             "pid": self.pid,
             "tid": 0,
-            "args": {"name": "deeperspeed_tpu"},
+            "args": {"name": proc},
         }]
         for lane, tid in sorted(lanes.items(), key=lambda kv: kv[1]):
             meta.append({
@@ -181,10 +244,13 @@ class Tracer:
         return meta
 
     def to_dict(self) -> dict:
+        other = {"dropped_events": self.dropped, "clock": dict(self.clock)}
+        if self.run_context is not None:
+            other["run"] = self.run_context.as_args()
         return {
             "traceEvents": self._metadata() + self.events(),
             "displayTimeUnit": "ms",
-            "otherData": {"dropped_events": self.dropped},
+            "otherData": other,
         }
 
     def save(self, path: str) -> str:

@@ -17,10 +17,18 @@ fails in CI instead of rendering as an empty timeline:
     drill trace missing the rid/reason fields the zero-loss audit keys
     on fails here, not in a dashboard.
 
+Strict mode adds name discipline: every non-metadata event must carry a
+name under a registered subsystem prefix (``engine/``, ``serving/``,
+``flight/``, ``goodput/``, ...) or be a known exact name
+(``xla_compile``, ``recompile!``). Default (non-strict) keeps the
+original behavior — unknown names pass, so ad-hoc spans in user code
+stay legal; strict is what CI runs on merged drill traces, where an
+unknown name means a producer and the schema drifted apart.
+
 Used two ways: as a library (``validate_events`` / ``validate_file``,
 the pytest round-trips a generated trace through it) and as a CLI::
 
-    python -m deeperspeed_tpu.monitor.validate trace.json
+    python -m deeperspeed_tpu.monitor.validate [--strict] trace.json
 
 exit 0 = valid, exit 1 = problems (one per line on stderr).
 """
@@ -44,7 +52,31 @@ EVENT_ARG_SCHEMAS = {
     "serving/shed": ("rid", "retry_after_s"),
     "serving/retry": ("rid", "attempt", "replica"),
     "serving/replica_down": ("replica", "cause", "inflight"),
+    # run-scoped observability (flight recorder / aggregate / goodput)
+    "serving/dispatch": ("rid", "replica", "attempt"),
+    "trace/dropped": ("dropped",),
+    "flight/recovered": ("count", "torn", "source"),
+    "run/start": ("run_id", "role", "incarnation"),
+    "run/preempt": ("signum",),
+    "goodput/report": ("wall_s", "goodput"),
 }
+
+# strict-mode name discipline: one prefix per subsystem that emits
+# events, plus the exact names outside any subsystem
+KNOWN_EVENT_PREFIXES = (
+    "engine/", "pipe/", "offload/", "comm/", "kernels/", "datapipe/",
+    "resilience/", "serving/", "flight/", "run/", "goodput/", "trace/",
+    "monitor/",
+)
+KNOWN_EVENT_NAMES = frozenset({
+    "xla_compile", "recompile!", "process_name", "thread_name",
+})
+
+
+def _known_name(name) -> bool:
+    return (isinstance(name, str)
+            and (name in KNOWN_EVENT_NAMES
+                 or name.startswith(KNOWN_EVENT_PREFIXES)))
 
 _NUM = (int, float)
 
@@ -53,8 +85,10 @@ def _is_num(v) -> bool:
     return isinstance(v, _NUM) and not isinstance(v, bool)
 
 
-def validate_events(events) -> List[str]:
-    """Returns a list of problems; empty means the trace is valid."""
+def validate_events(events, strict: bool = False) -> List[str]:
+    """Returns a list of problems; empty means the trace is valid.
+    ``strict`` additionally rejects event names outside the registered
+    subsystem prefixes / known exact names."""
     if not isinstance(events, list):
         return [f"traceEvents must be a list, got {type(events).__name__}"]
     errors: List[str] = []
@@ -81,6 +115,10 @@ def validate_events(events) -> List[str]:
         if "name" not in ev:
             errors.append(f"{where} (ph={ph}): missing required field "
                           f"'name'")
+        elif strict and not _known_name(ev["name"]):
+            errors.append(
+                f"{where} (ph={ph}): unknown event name {ev['name']!r} "
+                f"(strict mode requires a registered subsystem prefix)")
         ts = ev.get("ts")
         if ts is None:
             errors.append(f"{where} (ph={ph}): missing required field 'ts'")
@@ -130,7 +168,7 @@ def validate_events(events) -> List[str]:
     return errors
 
 
-def validate_file(path: str) -> List[str]:
+def validate_file(path: str, strict: bool = False) -> List[str]:
     try:
         with open(path) as f:
             doc = json.load(f)
@@ -142,22 +180,26 @@ def validate_file(path: str) -> List[str]:
         if "traceEvents" not in doc:
             return [f"{path}: object form must carry 'traceEvents'"]
         doc = doc["traceEvents"]
-    return validate_events(doc)
+    return validate_events(doc, strict=strict)
 
 
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else list(argv)
+    strict = False
+    if "--strict" in argv:
+        strict = True
+        argv = [a for a in argv if a != "--strict"]
     if len(argv) != 1 or argv[0] in ("-h", "--help"):
         print(__doc__, file=sys.stderr)
         return 2
-    errors = validate_file(argv[0])
+    errors = validate_file(argv[0], strict=strict)
     if errors:
         for e in errors:
             print(e, file=sys.stderr)
         print(f"{argv[0]}: INVALID ({len(errors)} problem(s))",
               file=sys.stderr)
         return 1
-    print(f"{argv[0]}: OK")
+    print(f"{argv[0]}: OK{' (strict)' if strict else ''}")
     return 0
 
 

@@ -26,9 +26,11 @@ across a multi-request run.
 """
 
 import threading
+import time
 from typing import Callable, Dict, List, Optional
 
 from ..utils.logging import logger
+from .runctx import current as current_run
 from .tracer import trace_instant
 
 __all__ = ["RecompileError", "RecompileWatchdog", "install_compile_listener"]
@@ -38,15 +40,17 @@ MODES = ("off", "warn", "strict")
 # process-global compile-event counter fed by jax.monitoring (see
 # install_compile_listener); None until the listener is installed
 _compile_events = 0
+_last_compile_t: Optional[float] = None  # perf_counter of the newest one
 _listener_installed = False
 _listener_lock = threading.Lock()
 _COMPILE_EVENT_KEY = "backend_compile"
 
 
 def _on_duration_event(event: str, duration: float, **kwargs) -> None:
-    global _compile_events
+    global _compile_events, _last_compile_t
     if _COMPILE_EVENT_KEY in event:
         _compile_events += 1
+        _last_compile_t = time.perf_counter()
         trace_instant("xla_compile", lane="compile",
                       seconds=round(duration, 4))
 
@@ -137,10 +141,13 @@ class RecompileWatchdog:
             for n in names:
                 self._baseline[n] = _cache_size(self._fns[n])
 
-    def observe(self, name: Optional[str] = None) -> List[str]:
+    def observe(self, name: Optional[str] = None,
+                step: Optional[int] = None) -> List[str]:
         """Compare watched functions' cache sizes against their warm
         baselines; returns the names that recompiled (after firing the
-        configured reaction for each)."""
+        configured reaction for each). ``step`` is the caller's step
+        counter, carried into the warning/instant so a firing is
+        attributable to a specific point in the run."""
         if not self.enabled:
             return []
         with self._lock:
@@ -161,18 +168,35 @@ class RecompileWatchdog:
                 with self._lock:
                     self._baseline[n] = size  # report each growth once
                 recompiled.append(n)
-                self._fire(n, base, size)
+                self._fire(n, base, size, step=step)
         return recompiled
 
     # -------------------------------------------------------------- #
 
-    def _fire(self, name: str, baseline: int, size: int) -> None:
-        record = {"name": name, "baseline": baseline, "cache_size": size}
+    def _fire(self, name: str, baseline: int, size: int,
+              step: Optional[int] = None) -> None:
+        rc = current_run()
+        since = (time.perf_counter() - _last_compile_t
+                 if _last_compile_t is not None else None)
+        record = {"name": name, "baseline": baseline, "cache_size": size,
+                  "step": step, "run_id": rc.run_id,
+                  "since_last_compile_s": since}
         self.fired.append(record)
-        trace_instant("recompile!", lane="compile", fn=name,
-                      cache_size=size)
+        args = {"fn": name, "cache_size": size,
+                "run_id": rc.run_id or "", "role": rc.role,
+                "incarnation": rc.incarnation}
+        if step is not None:
+            args["step"] = step
+        if since is not None:
+            args["since_last_compile_s"] = round(since, 3)
+        trace_instant("recompile!", lane="compile", **args)
+        ctx = f" [run {rc.run_id}]" if rc.run_id else ""
+        if step is not None:
+            ctx += f" at step {step}"
+        if since is not None:
+            ctx += f", {since:.1f}s since the last backend compile"
         msg = (f"recompile watchdog: {name!r} recompiled after warmup "
-               f"(jit cache {baseline} -> {size}); a shape/dtype is "
+               f"(jit cache {baseline} -> {size}){ctx}; a shape/dtype is "
                f"leaking into the trace")
         if self.mode == "strict":
             raise RecompileError(msg)

@@ -16,6 +16,7 @@ import signal
 import threading
 from typing import Callable, Dict, Optional, Sequence
 
+from ..monitor.tracer import trace_instant
 from ..utils.logging import logger
 
 
@@ -68,7 +69,10 @@ class PreemptionGuard:
             raise KeyboardInterrupt
         self._signum = signum
         self._requested.set()
-        # signal-safe work only: flag + (reentrant-safe) log
+        # signal-safe work only: flag + (reentrant-safe) log; the trace
+        # instant is a dict append under a non-reentrant path only if a
+        # drop-note fires, which the guard tolerates (tracing is advisory)
+        trace_instant("run/preempt", lane="run", signum=int(signum))
         logger.warning(
             "received %s: urgent checkpoint at the next step boundary, "
             "then exit (signal again with SIGINT to abort immediately)",
@@ -90,6 +94,7 @@ class PreemptionGuard:
         """Programmatic preemption (tests / external schedulers)."""
         self._signum = int(signum)
         self._requested.set()
+        trace_instant("run/preempt", lane="run", signum=int(signum))
 
     def clear(self) -> None:
         self._requested.clear()

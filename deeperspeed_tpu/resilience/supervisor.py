@@ -48,6 +48,12 @@ import time
 from dataclasses import dataclass
 from typing import Callable, List, Optional, Sequence
 
+from ..monitor.runctx import (
+    INCARNATION_ENV,
+    ROLE_ENV,
+    RUN_ID_ENV,
+    ensure_run_id,
+)
 from ..utils.logging import logger
 from .config import PREEMPTION_EXIT_CODE_DEFAULT
 from .manifest import find_latest_valid_tag, tag_step
@@ -114,6 +120,9 @@ class Supervisor:
         self.history: List[int] = []  # child return codes, in order
         self.world_history: List[Optional[int]] = []  # world per launch
         self._last_reason: Optional[str] = None  # why the NEXT launch is one
+        # run-scoped observability: every incarnation of this run shares
+        # one run_id; the child's role/incarnation label its trace lane
+        self.run_id = ensure_run_id()
 
     @staticmethod
     def _run_subprocess(cmd: List[str], env: dict) -> int:
@@ -124,6 +133,9 @@ class Supervisor:
     def _child_env(self) -> dict:
         env = dict(os.environ)
         env[RESTART_COUNT_ENV] = str(self.restarts)
+        env[RUN_ID_ENV] = self.run_id
+        env.setdefault(ROLE_ENV, "trainer")
+        env[INCARNATION_ENV] = str(self.restarts)
         if self._last_reason is not None:
             env[RESTART_REASON_ENV] = self._last_reason
         pol = self.policy
@@ -204,7 +216,7 @@ class Supervisor:
         """Append one transition record to the restart JSONL log."""
         if self.policy.restart_log is None:
             return
-        record = {"ts": time.time(), **record}
+        record = {"ts": time.time(), "run_id": self.run_id, **record}
         try:
             parent = os.path.dirname(self.policy.restart_log)
             if parent:

@@ -196,6 +196,12 @@ class Engine(ConfigAccessorsMixin):
             self.monitor = init_monitor(config.monitor_config())
         else:
             self.monitor = get_monitor()
+        if self.monitor is not None:
+            # anchors the run's trace lane: run id + which incarnation
+            # this process is (the supervisor bumps it every relaunch)
+            rc = self.monitor.run_context
+            trace_instant("run/start", lane="run", run_id=rc.run_id or "",
+                          role=rc.role, incarnation=rc.incarnation)
         # fused Pallas kernels: the "kernels" config block selects the
         # fused elementwise/optimizer/super-tile kernels. Applied
         # process-globally (ops/kernel_config.py) because the consumers
@@ -1420,7 +1426,7 @@ class Engine(ConfigAccessorsMixin):
             if self._wd_warmup_left:
                 self._wd_warmup_left -= 1
             else:
-                wd.observe()
+                wd.observe(step=self.global_steps)
         self.micro_steps += self.gradient_accumulation_steps()
         self._after_optimizer_step(metrics)
         self.tput_timer.stop(global_step=True, sync_with=metrics["loss"])

@@ -449,7 +449,8 @@ class ServingEngine(_ServingBase):
             nxt = np.asarray(nxt)                   # device sync
             timer.stop()
         if self.telemetry is not None:
-            self.telemetry.watchdog.observe("serving/decode_step")
+            self.telemetry.watchdog.observe("serving/decode_step",
+                                            step=self._step_i)
         self.metrics.record_decode_step(len(active), len(self.sched.queue),
                                         self.clock())
         for s, req in active:

@@ -38,6 +38,13 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from ..monitor.runctx import (
+    INCARNATION_ENV,
+    ROLE_ENV,
+    RUN_ID_ENV,
+    ensure_run_id,
+    estimate_clock_offset,
+)
 from .engine import EngineDrainingError
 
 __all__ = [
@@ -253,6 +260,10 @@ class SubprocessReplica:
         self.restarts = 0
         self.heartbeat_t = float("-inf")
         self.progress = 0
+        # wall-clock skew measured by the post-ready handshake: how far
+        # the child's clock runs ahead of ours (seconds); feeds the
+        # trace aggregator's --offsets alignment
+        self.clock_offset_s: Optional[float] = None
         self._proc: Optional[subprocess.Popen] = None
         self._reader: Optional[threading.Thread] = None
         self._events: "queue.Queue[dict]" = queue.Queue()
@@ -276,6 +287,11 @@ class SubprocessReplica:
             json.dump(self._spec, f)
         env = dict(os.environ)
         env.setdefault("JAX_PLATFORMS", "cpu")
+        # run-scoped observability: the child's trace lane is labeled by
+        # role + incarnation, correlated to ours by the shared run id
+        env[RUN_ID_ENV] = ensure_run_id()
+        env[ROLE_ENV] = f"replica-{self.name}"
+        env[INCARNATION_ENV] = str(self.restarts)
         env.update(self._env)
         self._ready_evt = threading.Event()
         self._draining = False
@@ -308,6 +324,13 @@ class SubprocessReplica:
                     f"{self._ready_timeout_s}s; see {self.stderr_path}")
             time.sleep(0.01)
         self.heartbeat_t = self._clock()
+        # NTP-style clock handshake: t0 here, t_child there, t1 here;
+        # the reply is matched in _read_stdout. Best-effort — a replica
+        # that dies mid-handshake just stays unaligned.
+        try:
+            self._send({"op": "clock", "t0": time.time()})
+        except ReplicaUnavailableError:
+            pass
 
     @property
     def alive(self) -> bool:
@@ -410,6 +433,13 @@ class SubprocessReplica:
             elif kind == "ready":
                 self.heartbeat_t = self._clock()
                 self._ready_evt.set()
+            elif kind == "clock":
+                t0 = ev.get("t0")
+                t_child = ev.get("t_child")
+                if isinstance(t0, (int, float)) and isinstance(
+                        t_child, (int, float)):
+                    self.clock_offset_s = estimate_clock_offset(
+                        t0, t_child, time.time())
             elif kind == "bye":
                 pass
             else:

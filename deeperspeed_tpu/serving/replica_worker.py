@@ -86,7 +86,17 @@ def build_engine(spec: dict):
 
 
 def serve(spec: dict, injector=None) -> int:
+    from ..monitor import init_monitor, shutdown_monitor
+    from ..monitor.runctx import current as current_run
     from .engine import EngineDrainingError
+
+    run_ctx = current_run()
+    if spec.get("monitor"):
+        # before build_engine so warmup compiles and admits are traced;
+        # with an obs_dir the paths derive from DS_TPU_ROLE/INCARNATION
+        # set by the parent fleet, and the flight recorder makes this
+        # worker's tail survive the drill's SIGKILL
+        init_monitor(spec["monitor"])
 
     eng = build_engine(spec)
     if injector is None:
@@ -109,7 +119,8 @@ def serve(spec: dict, injector=None) -> int:
 
     ops: "queue.Queue[Optional[dict]]" = queue.Queue()
     threading.Thread(target=_stdin_reader, args=(ops,), daemon=True).start()
-    _emit({"ev": "ready"})
+    _emit({"ev": "ready", "run_id": run_ctx.run_id, "role": run_ctx.role,
+           "incarnation": run_ctx.incarnation, "wall_t": time.time()})
 
     poll_s = float(spec.get("poll_interval_s", 0.002))
     decode_i = 0
@@ -147,6 +158,11 @@ def serve(spec: dict, injector=None) -> int:
                 eng.cancel(op["rid"], op.get("reason", "timeout"))
             elif kind == "drain":
                 draining = True
+            elif kind == "clock":
+                # NTP-style handshake leg: echo the parent's t0 with our
+                # wall time so it can estimate this host's clock offset
+                _emit({"ev": "clock", "t0": op.get("t0"),
+                       "t_child": time.time()})
             else:
                 print(f"replica_worker: unknown op {op!r}", file=sys.stderr)
         if stopping:
@@ -178,6 +194,7 @@ def serve(spec: dict, injector=None) -> int:
         if draining and not inflight and not eng.has_work():
             break
 
+    shutdown_monitor(save=True)   # graceful exits write the full trace
     _emit({"ev": "bye"})
     return 0
 

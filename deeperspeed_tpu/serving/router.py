@@ -431,6 +431,10 @@ class FleetRouter:
             rec.attempts += 1
             rec.assigned = target.name
             target.assigned.add(rid)
+            # the flow-arrow source: the aggregator pairs this with the
+            # replica-side serving/admit carrying the same rid
+            trace_instant("serving/dispatch", _TRACE_LANE, rid=rid,
+                          replica=target.name, attempt=rec.attempts)
             if rec.attempts > 1:
                 self.metrics.record_retry()
                 trace_instant("serving/retry", _TRACE_LANE, rid=rid,

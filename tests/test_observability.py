@@ -1,0 +1,593 @@
+"""Run-scoped observability tests: the crash-proof flight recorder
+(round-trip, ring wraparound, torn-record and truncated-file recovery),
+run-context propagation + NTP-style clock-offset math, the goodput
+ledger on a synthetic restart log, the cross-process trace aggregator
+(lanes, flight markers, rid flow arrows, strict validation), the
+validator's strict-mode CLI contract, drop-note accounting, watchdog
+firing context, the Monitor's obs_dir layout, and (slow) a real
+subprocess replica SIGKILLed mid-decode whose flight.bin still tells
+the story."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from deeperspeed_tpu.monitor import (
+    Tracer,
+    get_monitor,
+    init_monitor,
+    set_tracer,
+    shutdown_monitor,
+    trace_instant,
+)
+from deeperspeed_tpu.monitor.aggregate import load_source, merge_files
+from deeperspeed_tpu.monitor.flight import (
+    HEADER_BYTES,
+    FlightRecorder,
+    is_flight_file,
+    recover,
+)
+from deeperspeed_tpu.monitor.goodput import (
+    classify_incarnation,
+    compute_goodput,
+    interval_measure,
+    interval_subtract,
+    interval_union,
+)
+from deeperspeed_tpu.monitor.runctx import (
+    INCARNATION_ENV,
+    ROLE_ENV,
+    RUN_ID_ENV,
+    child_env,
+    current,
+    ensure_run_id,
+    estimate_clock_offset,
+)
+from deeperspeed_tpu.monitor.validate import main as validate_main
+from deeperspeed_tpu.monitor.validate import validate_events
+from deeperspeed_tpu.monitor.watchdog import RecompileWatchdog
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_global_monitor():
+    """Telemetry state is process-global; leave no tracer/monitor behind."""
+    yield
+    shutdown_monitor(save=False)
+    set_tracer(None)
+
+
+@pytest.fixture()
+def _run_env(monkeypatch):
+    """A pinned run context, restored afterwards."""
+    monkeypatch.setenv(RUN_ID_ENV, "run-test")
+    monkeypatch.setenv(ROLE_ENV, "trainer")
+    monkeypatch.setenv(INCARNATION_ENV, "1")
+
+
+def _ev(name, ts, i=0, **args):
+    return {"name": name, "ph": "i", "s": "t", "ts": float(ts),
+            "pid": os.getpid(), "tid": 1 + i,
+            **({"args": args} if args else {})}
+
+
+# ------------------------------------------------------------------ #
+# flight recorder
+# ------------------------------------------------------------------ #
+
+
+def test_flight_round_trip_carries_run_context(tmp_path, _run_env):
+    path = str(tmp_path / "f.bin")
+    fl = FlightRecorder(path, capacity=16, slot_bytes=256)
+    events = [_ev(f"engine/e{i}", 1000.0 * i, step=i) for i in range(5)]
+    for ev in events:
+        fl.append(ev)
+    fl.close()
+    assert is_flight_file(path)
+    snap = recover(path)
+    assert snap.events == events
+    assert snap.torn == 0 and snap.overwritten == 0
+    assert snap.meta["run_id"] == "run-test"
+    assert snap.meta["role"] == "trainer"
+    assert snap.meta["incarnation"] == 1
+    assert snap.meta["pid"] == os.getpid()
+    assert {"wall", "perf"} <= set(snap.meta["clock"])
+
+
+def test_flight_ring_wraparound_keeps_newest(tmp_path):
+    path = str(tmp_path / "f.bin")
+    fl = FlightRecorder(path, capacity=8, slot_bytes=128)
+    for i in range(12):
+        fl.append(_ev(f"engine/e{i}", i))
+    fl.close()
+    snap = recover(path)
+    assert [e["name"] for e in snap.events] == \
+        [f"engine/e{i}" for i in range(4, 12)]
+    assert snap.overwritten == 4 and snap.torn == 0
+    assert snap.last_seq == 12
+
+
+def test_flight_recovers_despite_torn_final_record(tmp_path):
+    """A record corrupted mid-write (the SIGKILL landing between bytes)
+    fails its CRC and is reported as torn; the rest survives."""
+    path = str(tmp_path / "f.bin")
+    slot_bytes = 128
+    fl = FlightRecorder(path, capacity=8, slot_bytes=slot_bytes)
+    for i in range(5):
+        fl.append(_ev(f"engine/e{i}", i))
+    fl.close()
+    # flip one payload byte of the last-written slot (seq 5 -> slot 4)
+    off = HEADER_BYTES + 4 * slot_bytes + 16 + 2
+    with open(path, "r+b") as f:
+        f.seek(off)
+        b = f.read(1)
+        f.seek(off)
+        f.write(bytes([b[0] ^ 0xFF]))
+    snap = recover(path)
+    assert snap.torn == 1
+    assert [e["name"] for e in snap.events] == \
+        [f"engine/e{i}" for i in range(4)]
+
+
+def test_flight_tolerates_truncated_file(tmp_path):
+    path = str(tmp_path / "f.bin")
+    slot_bytes = 128
+    fl = FlightRecorder(path, capacity=8, slot_bytes=slot_bytes)
+    for i in range(8):
+        fl.append(_ev(f"engine/e{i}", i))
+    fl.close()
+    with open(path, "r+b") as f:
+        f.truncate(HEADER_BYTES + 3 * slot_bytes + 7)  # mid-slot 3
+    snap = recover(path)  # no raise: everything past the cut is gone
+    assert [e["name"] for e in snap.events] == \
+        [f"engine/e{i}" for i in range(3)]
+
+
+def test_flight_shrinks_oversized_event_to_envelope(tmp_path):
+    path = str(tmp_path / "f.bin")
+    fl = FlightRecorder(path, capacity=4, slot_bytes=160)
+    fl.append(_ev("engine/big", 1.0, note="x" * 4096))
+    fl.close()
+    (ev,) = recover(path).events
+    assert ev["name"] == "engine/big"
+    assert ev["args"] == {"truncated": True}
+
+
+def test_flight_rejects_garbage_header(tmp_path):
+    p = tmp_path / "not_flight.bin"
+    p.write_bytes(b"\0" * (HEADER_BYTES + 10))
+    assert not is_flight_file(str(p))
+    with pytest.raises(ValueError):
+        recover(str(p))
+
+
+# ------------------------------------------------------------------ #
+# run context + clock offset
+# ------------------------------------------------------------------ #
+
+
+def test_runctx_env_round_trip(monkeypatch):
+    for var in (RUN_ID_ENV, ROLE_ENV, INCARNATION_ENV):
+        monkeypatch.delenv(var, raising=False)
+    rc = current()
+    assert rc.run_id is None and rc.role == "main" and rc.incarnation == 0
+    rid = ensure_run_id()
+    assert rid and os.environ[RUN_ID_ENV] == rid
+    assert ensure_run_id() == rid          # idempotent once minted
+    env = child_env("replica-r1", 3, base={})
+    assert env[RUN_ID_ENV] == rid
+    assert env[ROLE_ENV] == "replica-r1"
+    assert env[INCARNATION_ENV] == "3"
+    monkeypatch.setenv(ROLE_ENV, "replica-r1")
+    monkeypatch.setenv(INCARNATION_ENV, "3")
+    rc = current()
+    assert rc.run_id == rid and rc.role == "replica-r1"
+    assert rc.incarnation == 3
+    assert rc.as_args() == {"run_id": rid, "role": "replica-r1",
+                            "incarnation": 3}
+
+
+def test_estimate_clock_offset_math():
+    # remote stamped 15.5 at our midpoint 10.5 -> it runs 5s ahead
+    assert estimate_clock_offset(10.0, 15.5, 11.0) == 5.0
+    assert estimate_clock_offset(10.0, 10.5, 11.0) == 0.0
+    assert estimate_clock_offset(0.0, -2.0, 4.0) == -4.0  # remote behind
+
+
+def test_interval_arithmetic():
+    u = interval_union([(3, 5), (1, 2), (4, 7), (9, 9)])
+    assert u == [(1, 2), (3, 7)]
+    assert interval_subtract(u, [(4, 6)]) == [(1, 2), (3, 4), (6, 7)]
+    assert interval_measure(u) == 5
+
+
+# ------------------------------------------------------------------ #
+# goodput ledger
+# ------------------------------------------------------------------ #
+
+
+def _span(name, ts_us, dur_us, **args):
+    return {"name": name, "ph": "X", "ts": float(ts_us),
+            "dur": float(dur_us), "pid": 1, "tid": 1,
+            **({"args": args} if args else {})}
+
+
+def test_classify_incarnation_precedence_and_rework():
+    events = [
+        # compile listener fires when the compile ENDS: (0.5s, 1.0s)
+        _ev("xla_compile", 1_000_000, seconds=0.5),
+        _span("engine/train_batch", 500_000, 1_000_000, step=0),
+        _span("engine/train_batch", 1_500_000, 500_000, step=1),
+        _span("resilience/write", 2_000_000, 300_000),
+        _span("datapipe/wait", 2_300_000, 200_000),
+    ]
+    inc, max_step = classify_incarnation(events, prev_max_step=-1)
+    # the compile inside the first train span is compile, not productive
+    assert inc["compile"] == pytest.approx(0.5)
+    assert inc["productive"] == pytest.approx(1.0)
+    assert inc["checkpoint"] == pytest.approx(0.3)
+    assert inc["stall"] == pytest.approx(0.2)
+    assert inc["rework"] == 0.0
+    assert max_step == 1
+    # next incarnation replays step 1 before new work
+    inc2, max2 = classify_incarnation(
+        [_span("engine/train_batch", 0, 400_000, step=1),
+         _span("engine/train_batch", 400_000, 600_000, step=2)],
+        prev_max_step=max_step)
+    assert inc2["rework"] == pytest.approx(0.4)
+    assert inc2["productive"] == pytest.approx(0.6)
+    assert max2 == 2
+
+
+def test_goodput_buckets_sum_to_wall_on_synthetic_restart_log():
+    restart_log = [
+        {"event": "launch", "ts": 100.0},
+        {"event": "exit", "ts": 104.0, "code": 137},
+        {"event": "launch", "ts": 104.5},       # 0.5s restart gap
+        {"event": "exit", "ts": 108.5, "code": 0},
+    ]
+    inc0 = [
+        _ev("xla_compile", 1_000_000, seconds=0.5),
+        _span("engine/train_batch", 500_000, 1_000_000, step=0),
+        _span("engine/train_batch", 1_500_000, 500_000, step=1),
+        _span("resilience/write", 2_000_000, 300_000),
+        _span("datapipe/wait", 2_300_000, 200_000),
+    ]
+    inc1 = [
+        _span("engine/train_batch", 0, 400_000, step=1),   # replay
+        _span("engine/train_batch", 400_000, 600_000, step=2),
+    ]
+    report = compute_goodput(restart_log, [inc0, inc1], emit_trace=False)
+    b = report["buckets"]
+    assert report["wall_s"] == pytest.approx(8.5)
+    assert b["restart"] == pytest.approx(0.5)
+    assert b["compile"] == pytest.approx(0.5)
+    assert b["checkpoint"] == pytest.approx(0.3)
+    assert b["stall"] == pytest.approx(0.2)
+    assert b["rework"] == pytest.approx(0.4)
+    assert b["productive"] == pytest.approx(1.6)
+    # child remainders: (4.0 - 2.0) + (4.0 - 1.0)
+    assert b["other"] == pytest.approx(5.0)
+    assert sum(b.values()) == pytest.approx(report["wall_s"])
+    assert report["accounted_fraction"] == pytest.approx(1.0)
+    assert report["goodput"] == pytest.approx(1.6 / 8.5, abs=1e-4)
+    assert report["restarts"] == 1
+    assert report["incarnations"][1]["rework"] == pytest.approx(0.4)
+
+
+def test_goodput_exports_gauges_and_reads_flight_files(tmp_path):
+    from deeperspeed_tpu.monitor.metrics import MetricsRegistry
+
+    fp = str(tmp_path / "trainer.i0.flight.bin")
+    fl = FlightRecorder(fp, capacity=16, slot_bytes=256)
+    fl.append(_span("engine/train_batch", 0, 2_000_000, step=0))
+    fl.close()
+    restart_log = [{"event": "launch", "ts": 0.0},
+                   {"event": "exit", "ts": 4.0, "code": 0}]
+    reg = MetricsRegistry()
+    report = compute_goodput(restart_log, [fp], registry=reg,
+                             emit_trace=False)
+    assert report["buckets"]["productive"] == pytest.approx(2.0)
+    text = reg.render()
+    assert "goodput_fraction 0.5" in text
+    assert 'goodput_seconds{bucket="productive"} 2' in text
+
+
+# ------------------------------------------------------------------ #
+# aggregate: merge, lanes, flows, strict validation
+# ------------------------------------------------------------------ #
+
+
+def _router_trace(tmp_path, monkeypatch):
+    monkeypatch.setenv(RUN_ID_ENV, "run-agg")
+    monkeypatch.setenv(ROLE_ENV, "router")
+    monkeypatch.setenv(INCARNATION_ENV, "0")
+    t = Tracer()
+    t.instant("serving/dispatch", lane="serving", rid="q1",
+              replica="r0", attempt=1)
+    path = str(tmp_path / "router.i0.trace.json")
+    t.save(path)
+    return path
+
+
+def _replica_flight(tmp_path, monkeypatch):
+    monkeypatch.setenv(ROLE_ENV, "replica-r0")
+    fl = FlightRecorder(str(tmp_path / "replica-r0.i0.flight.bin"),
+                        capacity=32, slot_bytes=256)
+    fl.append(_ev("serving/admit", time.perf_counter() * 1e6, rid="q1"))
+    fl.append(_span("serving/decode", time.perf_counter() * 1e6, 1000,
+                    rid="q1"))
+    fl.close()
+    return fl.path
+
+
+def test_aggregate_merges_trace_and_flight_with_flows(
+        tmp_path, monkeypatch):
+    router = _router_trace(tmp_path, monkeypatch)
+    time.sleep(0.002)   # admit must land after dispatch on the timeline
+    flight = _replica_flight(tmp_path, monkeypatch)
+    out = str(tmp_path / "merged.json")
+    doc, stats = merge_files([router, flight], out=out)
+    assert validate_events(doc["traceEvents"], strict=True) == []
+    labels = {s["label"] for s in stats["sources"]}
+    assert labels == {"router#0", "replica-r0#0 (flight)"}
+    assert stats["recovered_events"] == 2
+    assert stats["flow_arrows"] == 1
+    by_name = {}
+    for ev in doc["traceEvents"]:
+        by_name.setdefault(ev["name"], []).append(ev)
+    # synthetic per-source lanes + flight marker + stamped run id
+    assert {m["args"]["name"] for m in by_name["process_name"]} == labels
+    assert by_name["flight/recovered"][0]["args"]["count"] == 2
+    s, f = by_name["run/rid_hop"]
+    assert (s["ph"], f["ph"]) == ("s", "f")
+    assert s["pid"] != f["pid"] and f["ts"] >= s["ts"]
+    assert by_name["serving/admit"][0]["args"]["run_id"] == "run-agg"
+    # timeline rebased: validator requires ts >= 0
+    assert min(e["ts"] for e in doc["traceEvents"]
+               if e.get("ph") != "M") >= 0.0
+    # the written file round-trips through the CLI in strict mode
+    from deeperspeed_tpu.monitor.aggregate import main as agg_main
+    rc = agg_main(["--out", str(tmp_path / "merged2.json"), "--strict",
+                   router, flight])
+    assert rc == 0
+
+
+def test_aggregate_applies_handshake_offsets(tmp_path, monkeypatch):
+    router = _router_trace(tmp_path, monkeypatch)
+    flight = _replica_flight(tmp_path, monkeypatch)
+    src = load_source(flight)
+    assert src.kind == "flight" and src.recovered == 2
+    # a huge claimed clock skew shifts the replica's lane off the
+    # router's; the dispatch->admit pairing then finds no later admit
+    _, stats = merge_files(
+        [router, flight],
+        offsets_s={os.path.basename(flight): 3600.0})
+    assert stats["flow_arrows"] == 0
+
+
+# ------------------------------------------------------------------ #
+# validator strict mode (satellite)
+# ------------------------------------------------------------------ #
+
+
+def test_validator_strict_cli_rejects_unknown_names(tmp_path, capsys):
+    good = tmp_path / "good.json"
+    t = Tracer()
+    t.instant("engine/known", lane="engine")
+    t.instant("xla_compile", lane="compile", seconds=0.1)
+    t.save(str(good))
+    bad = tmp_path / "bad.json"
+    doc = json.loads(good.read_text())
+    doc["traceEvents"].append(
+        {"name": "bogus_event", "ph": "i", "s": "t", "ts": 1.0,
+         "pid": 1, "tid": 1})
+    bad.write_text(json.dumps(doc))
+
+    assert validate_main([str(good)]) == 0
+    assert validate_main(["--strict", str(good)]) == 0
+    # default keeps the old contract: unknown names pass
+    assert validate_main([str(bad)]) == 0
+    assert validate_main(["--strict", str(bad)]) == 1
+    err = capsys.readouterr().err
+    assert "bogus_event" in err and "strict" in err
+
+
+def test_validator_arg_schemas_for_observability_events():
+    def inst(name, **args):
+        return {"name": name, "ph": "i", "s": "t", "ts": 0.0,
+                "pid": 1, "tid": 1, "args": args}
+
+    ok = [
+        inst("trace/dropped", dropped=3),
+        inst("flight/recovered", count=2, torn=0, source="x.bin"),
+        inst("run/start", run_id="r", role="trainer", incarnation=0),
+        inst("run/preempt", signum=15),
+        inst("serving/dispatch", rid="a", replica="r0", attempt=1),
+        inst("goodput/report", wall_s=1.0, goodput=0.5),
+    ]
+    assert validate_events(ok, strict=True) == []
+    assert validate_events([inst("flight/recovered", count=2)])
+    assert validate_events([inst("run/start", run_id="r")])
+    assert validate_events([inst("goodput/report", wall_s=1.0)])
+
+
+# ------------------------------------------------------------------ #
+# drop-note accounting (satellite)
+# ------------------------------------------------------------------ #
+
+
+def test_tracer_drop_note_rides_ring_and_flight(tmp_path):
+    drops = []
+    fl = FlightRecorder(str(tmp_path / "f.bin"), capacity=64,
+                        slot_bytes=256)
+    t = Tracer(ring_size=8, flight=fl, on_drop=drops.append)
+    for i in range(9):
+        t.instant(f"engine/e{i}")
+    fl.close()
+    events = t.events()
+    assert len(events) == 8
+    notes = [e for e in events if e["name"] == "trace/dropped"]
+    assert len(notes) == 1
+    # the 9th append evicted e0; the note itself evicted e1
+    assert notes[0]["args"]["dropped"] == 2
+    assert t.dropped == 2 and sum(drops) == 2
+    assert t.to_dict()["otherData"]["dropped_events"] == 2
+    # the note reached the flight sink too (post-mortems see the loss)
+    flight_names = [e["name"] for e in recover(fl.path).events]
+    assert "trace/dropped" in flight_names
+    assert validate_events(t.to_dict()["traceEvents"], strict=True) == []
+
+
+# ------------------------------------------------------------------ #
+# watchdog firing context (satellite)
+# ------------------------------------------------------------------ #
+
+
+class _FakeJit:
+    """Stands in for a jitted callable: just the _cache_size probe."""
+
+    def __init__(self):
+        self.n = 0
+
+    def _cache_size(self):
+        return self.n
+
+
+def test_watchdog_fire_carries_run_step_and_compile_age(
+        monkeypatch, _run_env):
+    from deeperspeed_tpu.utils.logging import logger
+
+    warnings = []
+    monkeypatch.setattr(logger, "warning",
+                        lambda msg, *a: warnings.append(msg % a if a
+                                                        else msg))
+    t = Tracer()
+    set_tracer(t)
+    wd = RecompileWatchdog(mode="warn")
+    f = _FakeJit()
+    wd.watch("f", f)
+    f.n = 1
+    assert wd.observe(step=1) == []        # warmup baseline
+    f.n = 2
+    assert wd.observe(step=42) == ["f"]
+    rec = wd.fired[0]
+    assert rec["step"] == 42 and rec["run_id"] == "run-test"
+    (ev,) = [e for e in t.events() if e["name"] == "recompile!"]
+    assert ev["args"]["step"] == 42
+    assert ev["args"]["run_id"] == "run-test"
+    assert ev["args"]["role"] == "trainer"
+    assert ev["args"]["incarnation"] == 1
+    assert any("[run run-test] at step 42" in w for w in warnings)
+
+
+# ------------------------------------------------------------------ #
+# Monitor obs_dir layout
+# ------------------------------------------------------------------ #
+
+
+def test_monitor_obs_dir_derives_paths_and_flight(tmp_path, _run_env):
+    mon = init_monitor({"obs_dir": str(tmp_path), "watchdog": "off"})
+    assert mon is get_monitor()
+    assert mon.trace_path == str(tmp_path / "trainer.i1.trace.json")
+    assert mon.flight is not None
+    assert mon.flight.path == str(tmp_path / "trainer.i1.flight.bin")
+    trace_instant("engine/x", lane="engine", step=3)
+    # inline flight write: readable BEFORE any flush or close
+    snap = recover(mon.flight.path)
+    assert [e["name"] for e in snap.events] == ["engine/x"]
+    assert snap.meta["role"] == "trainer"
+    assert snap.meta["incarnation"] == 1
+    assert "monitor_dropped_events 0" in mon.registry.render()
+    shutdown_monitor(save=True)
+    assert (tmp_path / "trainer.i1.trace.json").exists()
+
+
+# ------------------------------------------------------------------ #
+# slow: a real replica SIGKILLed mid-decode leaves a readable tail
+# ------------------------------------------------------------------ #
+
+
+@pytest.mark.slow
+def test_flight_survives_replica_sigkill_mid_decode(tmp_path):
+    from deeperspeed_tpu.serving.fleet import SubprocessReplica
+
+    obs = tmp_path / "obs"
+    spec = {
+        "gpt": {"vocab_size": 97, "n_layer": 2, "n_head": 2,
+                "d_model": 32, "max_seq": 128, "remat": False,
+                "attn_impl": "xla"},
+        "init_seed": 0,
+        "serving": {"num_slots": 2, "block_size": 8, "num_blocks": 32,
+                    "max_seq_len": 128, "max_new_tokens": 64,
+                    "prefill_buckets": [16, 128]},
+        "warm": True,
+        "monitor": {"obs_dir": str(obs), "watchdog": "off"},
+        "faults": {"replica_sigkill_at_decode": 6,
+                   "flag_file": str(tmp_path / "flag")},
+    }
+    work = tmp_path / "work"
+    work.mkdir()
+    rep = SubprocessReplica("kx", spec,
+                            env={"JAX_PLATFORMS": "cpu"},
+                            workdir=str(work))
+    rep.start()
+    try:
+        rep.submit({"rid": "victim", "prompt": [1, 2, 3, 4, 5],
+                    "max_new_tokens": 48, "temperature": 0.0})
+        deadline = time.monotonic() + 120
+        while rep.alive and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert not rep.alive, "fault injection never killed the replica"
+        assert rep._proc.returncode == -9      # a real SIGKILL
+    finally:
+        rep.kill()
+    flight = obs / "replica-kx.i0.flight.bin"
+    assert flight.exists()
+    # no flush ever ran in the child, yet the tail reads back
+    snap = recover(str(flight))
+    assert snap.events, "SIGKILLed replica left an empty flight file"
+    assert snap.meta["role"] == "replica-kx"
+    names = {e["name"] for e in snap.events}
+    assert "serving/admit" in names            # the victim's admission
+    admits = [e for e in snap.events if e["name"] == "serving/admit"]
+    assert any((e.get("args") or {}).get("rid") == "victim"
+               for e in admits)
+    # and the graceful sibling artifact was never written: the flight
+    # file IS the only record of this incarnation
+    assert not (obs / "replica-kx.i0.trace.json").exists()
+
+
+@pytest.mark.slow
+@pytest.mark.drill
+def test_obs_drill_quick(tmp_path):
+    """CI wrapper for scripts/obs_drill.py: the full flight-recovery +
+    merge + goodput audit in its quick shape."""
+    out = tmp_path / "BENCH_obs.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "obs_drill.py"),
+         "--quick", "--out", str(out)],
+        env=env, capture_output=True, text=True, timeout=560)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    result = json.loads(out.read_text())
+    assert result["pass"] is True
+    fleet = result["fleet_merge"]
+    assert fleet["recovered_events"] >= 1
+    assert fleet["rids_traceable"] == fleet["accepted"]
+    assert fleet["strict_problems"] == 0
+    goodput = result["goodput"]
+    assert goodput["accounting_error"] <= 0.05
+    assert goodput["buckets"]["productive"] > 0
+    # the merged trace satisfies the validator CLI in strict mode
+    merged = os.path.join(REPO, fleet["merged_trace"])
+    rc = subprocess.run(
+        [sys.executable, "-m", "deeperspeed_tpu.monitor.validate",
+         "--strict", merged],
+        env=env, capture_output=True, text=True)
+    assert rc.returncode == 0, rc.stdout + rc.stderr

@@ -96,12 +96,16 @@ def test_tracer_lanes_get_stable_small_tids_and_metadata():
 
 
 def test_tracer_ring_bounds_memory_and_counts_drops():
-    t = Tracer(ring_size=16)
+    drops = []
+    t = Tracer(ring_size=16, on_drop=drops.append)
     for i in range(100):
         t.instant(f"e{i}")
     assert len(t.events()) == 16
-    assert t.dropped == 84
-    assert t.to_dict()["otherData"]["dropped_events"] == 84
+    # 84 user events evicted, plus the rate-limited trace/dropped note
+    # evicting one more when it joined the full ring
+    assert t.dropped == 85
+    assert sum(drops) == t.dropped
+    assert t.to_dict()["otherData"]["dropped_events"] == 85
     # eviction cannot orphan anything: spans are self-contained X events
     assert validate_events(t.to_dict()["traceEvents"]) == []
 
