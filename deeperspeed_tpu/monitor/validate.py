@@ -59,6 +59,12 @@ EVENT_ARG_SCHEMAS = {
     "run/start": ("run_id", "role", "incarnation"),
     "run/preempt": ("signum",),
     "goodput/report": ("wall_s", "goodput"),
+    # comm overlap scheduling: per-bucket reduce launches must say
+    # whether they were overlapped, and every drain must say how many
+    # buckets it waited on — overlap_fraction in BENCH_comm.json joins
+    # on exactly these spans
+    "comm/reduce": ("bucket", "mode"),
+    "comm/overlap_window": ("buckets",),
 }
 
 # strict-mode name discipline: one prefix per subsystem that emits

@@ -11,7 +11,8 @@ optimizer / short-sequence-attention residual is executed:
   auto   — Pallas on TPU when the per-surface geometry gates pass, XLA
            otherwise. This is the production setting.
 
-Per-surface booleans (fused_blocks / fused_adam / supertile) narrow a mode
+Per-surface booleans (fused_blocks / fused_adam / supertile / fused_quant)
+narrow a mode
 to a subset of surfaces, e.g. {"mode": "auto", "fused_adam": False} keeps
 the optimizer on XLA while fusing layernorm/gelu and attention.
 
@@ -26,7 +27,7 @@ import dataclasses
 import threading
 
 MODES = ("off", "fused", "auto")
-SURFACES = ("fused_blocks", "fused_adam", "supertile")
+SURFACES = ("fused_blocks", "fused_adam", "supertile", "fused_quant")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -36,6 +37,7 @@ class KernelsConfig:
     fused_blocks: bool = True
     fused_adam: bool = True
     supertile: bool = True
+    fused_quant: bool = True  # comm wire-format kernels (pallas/fused_quant)
 
 
 _LOCK = threading.Lock()

@@ -14,7 +14,8 @@ monolithic XLA-scheduled reduction at the end of backward.
         "block": 128,            # quantization block (int8/compressed)
         "error_feedback": true,  # persistent residuals for lossy modes
         "hierarchical": "auto",  # off | auto | on  (qgZ two-level)
-        "intra_size": null       # devices per host group (null = detect)
+        "intra_size": null,      # devices per host group (null = detect)
+        "overlap": "off"         # off | auto | on  (backward overlap)
     }
 
 ``mode`` picks the per-bucket wire format:
@@ -39,10 +40,11 @@ from typing import Optional
 
 MODES = ("fp32", "bf16", "int8", "compressed")
 HIERARCHICAL = ("off", "auto", "on")
+OVERLAP = ("off", "auto", "on")
 
 _KNOWN_KEYS = frozenset({
     "enabled", "mode", "bucket_mb", "block", "error_feedback",
-    "hierarchical", "intra_size",
+    "hierarchical", "intra_size", "overlap",
 })
 
 
@@ -70,6 +72,13 @@ class CommConfig:
     # devices per intra group for the hierarchical schedule; None detects
     # jax.local_device_count(); must divide the data-parallel world size
     intra_size: Optional[int] = None
+    # backward-overlap collective scheduling (runtime/comm/overlap.py):
+    # "on" forces it, "auto" enables it wherever it can apply (skipped
+    # for world==1 / the canonical-slot elastic mode, where there is
+    # nothing to overlap), "off" keeps the serialized post-backward path
+    # (bit-identical results either way — the schedule moves, the math
+    # does not)
+    overlap: str = "off"
 
     def __post_init__(self):
         if self.mode not in MODES:
@@ -87,6 +96,10 @@ class CommConfig:
         if self.intra_size is not None and int(self.intra_size) < 1:
             raise ValueError(
                 f"comm intra_size must be >= 1, got {self.intra_size}")
+        if self.overlap not in OVERLAP:
+            raise ValueError(
+                f"comm overlap must be one of {list(OVERLAP)}, "
+                f'got "{self.overlap}"')
 
     @property
     def bucket_bytes(self) -> int:

@@ -20,7 +20,20 @@ the style of the reference's 1-bit/compressed allreduce work:
 * an optional **hierarchical** (ZeRO++ qgZ style) schedule for the int8
   mode: intra-group reduce-scatter in full precision over the fast links,
   then quantized all_gather across groups, then a quantized intra-group
-  rebuild — selected when the mesh spans multiple hosts.
+  rebuild — selected when the mesh spans multiple hosts;
+* the quantize/pack/dequantize math routes through the **fused
+  wire-format kernels** of :mod:`...ops.pallas.fused_quant` when the
+  process-global ``"kernels"`` block enables the ``fused_quant`` surface:
+  single-pass quantize+scale+residual, unpack+dequant+accumulate, and
+  **packed scale transport** (values + bitcast scales in one int8
+  payload, halving the collective launches per bucket). ``kernels: off``
+  keeps the original unfused chains, byte-identical to PR 6;
+* backward-overlap scheduling (:mod:`.overlap`) when the comm block sets
+  ``"overlap": "auto"|"on"``: :meth:`GradReducer.reduce_dispatch` grows
+  an async mode (no per-bucket blocking; the engine drains at the
+  accumulation boundary) and :meth:`GradReducer.reduce_stacked` a
+  per-bucket emission mode so XLA can hide early-bucket collectives
+  under late-layer backward compute.
 
 All collectives run inside ``shard_map`` over the data axis on per-device
 gradient shards (the engine computes *local* grads, see
@@ -46,6 +59,7 @@ except ImportError:  # older jax: different module AND different kwarg name
     _SHMAP_CHECK_KWARGS = {"check_rep": False}
 
 from ...monitor import trace_span
+from ...ops.pallas import fused_quant
 from ...parallel.topology import DATA_AXIS
 from . import bucketing
 from .compressed import _compress_blocks, _decompress_blocks
@@ -265,23 +279,51 @@ class GradReducer:
             return out, {"e": c - sent.astype(jnp.float32) if ef
                          else res["e"]}
         if cfg.mode == "compressed":
-            c = v + res["e"] if ef else v
-            m, e = _compress_blocks(c, cfg.block)
-            new_e = (c - _decompress_blocks(m, e, v.shape[0]) if ef
-                     else res["e"])
-            ms = jax.lax.all_gather(m, ax)  # (W, nb, block) f16
-            es = jax.lax.all_gather(e, ax)  # (W, nb) s8
-            vals = jax.vmap(
-                lambda mm, ee: _decompress_blocks(mm, ee, v.shape[0]))(ms, es)
-            return jnp.sum(vals, axis=0) / W, {"e": new_e}
+            return self._reduce_compressed_flat(v, res)
         if self.hier_k:
             return self._reduce_int8_hier(v, res)
         return self._reduce_int8_flat(v, res)
+
+    def _reduce_compressed_flat(self, v, res):
+        """24-bit block-exponent gather: compress -> all_gather -> rebuild
+        the exact sum of quantized contributions.  With the fused_quant
+        surface active, mantissas + exponents ride ONE packed payload and
+        the W-way decompress+sum runs as a single dequant-accumulate
+        contraction (scales = 2^e, exact) instead of W materialized
+        fp32 copies."""
+        cfg, W, ax, block = self.cfg, self.world, self.axis, self.cfg.block
+        ef = cfg.error_feedback
+        L = v.shape[0]
+        c = v + res["e"] if ef else v
+        m, e = _compress_blocks(c, block)  # (nb, block) f16, (nb,) s8
+        new_e = c - _decompress_blocks(m, e, L) if ef else res["e"]
+        choice, interpret = fused_quant.routing()
+        if choice == "off":
+            ms = jax.lax.all_gather(m, ax)  # (W, nb, block) f16
+            es = jax.lax.all_gather(e, ax)  # (W, nb) s8
+            vals = jax.vmap(
+                lambda mm, ee: _decompress_blocks(mm, ee, L))(ms, es)
+            return jnp.sum(vals, axis=0) / W, {"e": new_e}
+        nb = L // block
+        payload = jnp.concatenate(
+            [jax.lax.bitcast_convert_type(m, jnp.int8).reshape(nb, -1),
+             e[:, None]], axis=1)  # (nb, 2*block + 1) int8
+        g = jax.lax.all_gather(payload, ax)  # (W, nb, 2*block + 1)
+        gm = jax.lax.bitcast_convert_type(
+            g[:, :, :2 * block].reshape(W, nb, block, 2), jnp.float16)
+        scales = jnp.exp2(g[:, :, -1].astype(jnp.float32))  # exact 2^e
+        total = fused_quant.dequant_sum_rows(
+            gm.reshape(W, L), scales, block, choice=choice,
+            interpret=interpret)
+        return total / W, {"e": new_e}
 
     def _reduce_int8_flat(self, v, res):
         """Two-phase int8: quantize -> all_to_all chunks -> exact partial
         sums -> re-quantize -> all_gather.  ~2(L + 4L/block) wire bytes vs
         8L for the fp32 ring — the EQuARX trade at 8 bits."""
+        choice, interpret = fused_quant.routing()
+        if choice != "off":
+            return self._reduce_int8_flat_fused(v, res, choice, interpret)
         cfg, W, ax, block = self.cfg, self.world, self.axis, self.cfg.block
         ef = cfg.error_feedback
         L = v.shape[0]
@@ -303,6 +345,41 @@ class GradReducer:
         out = (aq.astype(jnp.float32) * as_[..., None]).reshape(-1) / W
         return out, {"e": new_e, "e2": new_e2}
 
+    def _reduce_int8_flat_fused(self, v, res, choice, interpret):
+        """Same two-phase schedule through the fused wire-format kernels:
+        one quantize pass also emits the error-feedback residual, scales
+        ride bitcast inside the value payload (ONE collective per phase
+        instead of two), and each rebuild is a single dequant-accumulate
+        contraction. Bit-identical values to the unfused path on the XLA
+        route — the reference clip is a provable no-op and every multiply
+        /sum keeps its order (see fused_quant's module docstring)."""
+        cfg, W, ax, block = self.cfg, self.world, self.axis, self.cfg.block
+        ef = cfg.error_feedback
+        L = v.shape[0]
+        chunk = L // W
+        c = v + res["e"] if ef else v
+        q, s, r = fused_quant.quantize_rows(
+            c.reshape(W, chunk), block, want_residual=ef, choice=choice,
+            interpret=interpret)
+        new_e = r.reshape(-1) if ef else res["e"]
+        # chunk j of everyone's contribution to device j; scales packed
+        rwire = jax.lax.all_to_all(fused_quant.pack_wire(q, s), ax, 0, 0)
+        rq, rs = fused_quant.unpack_wire(rwire, chunk, block)
+        ssum = fused_quant.dequant_sum_rows(
+            rq, rs, block, choice=choice, interpret=interpret)
+        c2 = ssum + res["e2"] if ef else ssum
+        q2, s2, r2 = fused_quant.quantize_rows(
+            c2.reshape(1, chunk), block, want_residual=ef, choice=choice,
+            interpret=interpret)
+        new_e2 = r2.reshape(-1) if ef else res["e2"]
+        gwire = jax.lax.all_gather(
+            fused_quant.pack_wire(q2, s2).reshape(-1), ax)  # (W, chunk+4bpc)
+        gq, gs = fused_quant.unpack_wire(gwire, chunk, block)
+        out = fused_quant.dequant_rows(
+            gq, gs, block, divisor=W, choice=choice,
+            interpret=interpret).reshape(-1)
+        return out, {"e": new_e, "e2": new_e2}
+
     def _reduce_int8_hier(self, v, res):
         """qgZ-style two-level schedule: intra-group reduce-scatter in full
         precision (fast links), int8 all_gather across groups, then an int8
@@ -315,6 +392,31 @@ class GradReducer:
         chunk = jax.lax.psum_scatter(
             v, ax, scatter_dimension=0, axis_index_groups=intra, tiled=True)
         c1 = chunk + res["e1"] if ef else chunk
+        choice, interpret = fused_quant.routing()
+        if choice != "off":
+            L1 = c1.shape[0]
+            q, s, r = fused_quant.quantize_rows(
+                c1.reshape(1, L1), block, want_residual=ef, choice=choice,
+                interpret=interpret)
+            new_e1 = r.reshape(-1) if ef else res["e1"]
+            wire = fused_quant.pack_wire(q, s).reshape(-1)
+            gw = jax.lax.all_gather(wire, ax, axis_index_groups=inter)
+            gq, gs = fused_quant.unpack_wire(gw, L1, block)  # (nn, L1)
+            gsum = fused_quant.dequant_sum_rows(
+                gq, gs, block, choice=choice, interpret=interpret)
+            c2 = gsum + res["e2"] if ef else gsum
+            q2, s2, r2 = fused_quant.quantize_rows(
+                c2.reshape(1, L1), block, want_residual=ef, choice=choice,
+                interpret=interpret)
+            new_e2 = r2.reshape(-1) if ef else res["e2"]
+            fw = jax.lax.all_gather(
+                fused_quant.pack_wire(q2, s2).reshape(-1), ax,
+                axis_index_groups=intra)
+            fq, fs = fused_quant.unpack_wire(fw, L1, block)  # (k, L1)
+            out = fused_quant.dequant_rows(
+                fq, fs, block, divisor=W, choice=choice,
+                interpret=interpret).reshape(-1)
+            return out, {"e1": new_e1, "e2": new_e2}
         q, s = quantize_int8_blocks(c1, block)
         new_e1 = c1 - dequantize_int8_blocks(q, s) if ef else res["e1"]
         gq = jax.lax.all_gather(q, ax, axis_index_groups=inter)  # (nn,nb,blk)
@@ -382,18 +484,45 @@ class GradReducer:
     def _leaf_spec(self, shape) -> P:
         return P(self.axis, *([None] * len(shape)))
 
-    def reduce_stacked(self, stacked_tree, state):
+    def reduce_stacked(self, stacked_tree, state, *, per_bucket=False):
         """Reduce a tree of stacked local grads ((world, *shape) leaves,
         sharded over the data axis) to the tree of global means.
 
         Traceable — called inside the engine's fused train-step jit.
         Returns ``(mean_tree, new_state)``.
+
+        ``per_bucket=True`` (the overlap schedule, :mod:`.overlap`)
+        emits one ``shard_map`` per bucket instead of one for the whole
+        tree: each bucket's collective then depends only on its own
+        leaves' gradients, so XLA's scheduler can launch early-bucket
+        reductions while late-layer backward compute is still running.
+        Bit-identical either way — the per-bucket math never crosses
+        buckets; only the dependency structure handed to XLA changes.
         """
         leaves, treedef = jax.tree.flatten(stacked_tree)
         if len(leaves) != self.plan.n_leaves:
             raise ValueError(
                 f"grad tree has {len(leaves)} leaves but the bucket plan "
                 f"was built for {self.plan.n_leaves}")
+
+        if per_bucket:
+            outs = [None] * self.plan.n_leaves
+            new_state = []
+            for j, b in enumerate(self.plan.buckets):
+                res_spec = {k: P(self.axis, None)
+                            for k in self._residual_shapes(b)}
+                fn = shard_map(
+                    self._bucket_body(j), mesh=self.mesh,
+                    in_specs=([self._leaf_spec(s) for s in b.shapes],
+                              res_spec),
+                    out_specs=([P() for _ in b.shapes], res_spec),
+                    **_SHMAP_CHECK_KWARGS)
+                bucket_out, nr = fn([leaves[i] for i in b.leaf_ids],
+                                    state[j])
+                for i, leaf in zip(b.leaf_ids, bucket_out):
+                    outs[i] = leaf
+                new_state.append(nr)
+            return jax.tree.unflatten(treedef, outs), new_state
 
         def body(stacked, res_state):
             outs = [None] * self.plan.n_leaves
@@ -481,32 +610,50 @@ class GradReducer:
     # imperative per-bucket dispatch (backward()/step() path)
     # ------------------------------------------------------------------ #
 
+    def _bucket_body(self, j: int):
+        """shard_map body reducing bucket ``j`` (shared by the jitted
+        imperative dispatch and the per-bucket stacked emission)."""
+        b = self.plan.buckets[j]
+
+        def body(stacked, res_b):
+            flat = bucketing.pack(b, [s[0] for s in stacked])
+            red, nr = self._reduce_flat(flat, self._strip(res_b))
+            return bucketing.unpack(b, red), self._lift(nr)
+
+        return body
+
     def _bucket_reduce_fn(self, j: int):
         key = ("reduce", j)
         fn = self._jit_cache.get(key)
         if fn is None:
             b = self.plan.buckets[j]
-
-            def body(stacked, res_b):
-                flat = bucketing.pack(b, [s[0] for s in stacked])
-                red, nr = self._reduce_flat(flat, self._strip(res_b))
-                return bucketing.unpack(b, red), self._lift(nr)
-
             res_spec = {k: P(self.axis, None)
                         for k in self._residual_shapes(b)}
             in_specs = ([self._leaf_spec(shape) for shape in b.shapes],
                         res_spec)
             out_specs = ([P() for _ in b.shapes], res_spec)
-            fn = jax.jit(shard_map(body, mesh=self.mesh, in_specs=in_specs,
+            fn = jax.jit(shard_map(self._bucket_body(j), mesh=self.mesh,
+                                   in_specs=in_specs,
                                    out_specs=out_specs,
                                    **_SHMAP_CHECK_KWARGS))
             self._jit_cache[key] = fn
         return fn
 
-    def reduce_dispatch(self, stacked_tree, state):
+    def reduce_dispatch(self, stacked_tree, state, *, overlap=False):
         """Reduce bucket by bucket with one jitted dispatch each, wrapping
         every launch in a ``comm/reduce`` span and bumping the comm
-        counters.  Same math as :meth:`reduce_stacked`."""
+        counters.  Same math as :meth:`reduce_stacked`.
+
+        ``overlap=True`` (the :mod:`.overlap` schedule) launches every
+        bucket asynchronously: the per-bucket ``block_until_ready`` —
+        pure serialization; JAX dispatch is async anyway — is skipped,
+        so bucket ``j+1``'s collective is in flight before ``j``'s has
+        finished and the host returns to backward work immediately. The
+        caller (engine) registers the returned arrays with its
+        ``OverlapScheduler`` and drains at the accumulation boundary;
+        the spans then record the *launch* (``overlapped: true``), the
+        exposed wait shows up in ``comm/overlap_window``.
+        """
         if self.canonical:
             raise NotImplementedError(
                 "the imperative backward()/step() path does not support "
@@ -524,10 +671,11 @@ class GradReducer:
             wire = self.bucket_wire_bytes(b)
             with trace_span("comm/reduce", lane="comm", bucket=j,
                             mode=self.cfg.mode, elements=b.length,
-                            wire_bytes=wire):
+                            wire_bytes=wire, overlapped=bool(overlap)):
                 bucket_out, nr = fn([leaves[i] for i in b.leaf_ids],
                                     state[j])
-                bucket_out = jax.block_until_ready(bucket_out)
+                if not overlap:
+                    bucket_out = jax.block_until_ready(bucket_out)
             for i, leaf in zip(b.leaf_ids, bucket_out):
                 outs[i] = leaf
             new_state.append(nr)

@@ -328,6 +328,7 @@ class Engine(ConfigAccessorsMixin):
         self.comm = None
         self._comm_state = None
         self._comm_acc_reduced = None  # per-cycle backward() routing flag
+        self._comm_overlap = None      # OverlapScheduler when overlap is on
         if config.comm_config() is not None:
             reasons = []
             if self.zero_stage >= 2:
@@ -355,6 +356,16 @@ class Engine(ConfigAccessorsMixin):
                     canonical=self.canonical_shards)
                 self.comm.build_plan(params)
                 self._comm_state = self.comm.init_state()
+                # backward-overlap scheduling (comm/overlap.py): fused
+                # path emits per-bucket shard_maps so XLA hides early
+                # buckets under backward; imperative path dispatches
+                # async and drains at the step() boundary
+                from .comm import overlap as comm_overlap
+
+                if comm_overlap.resolve_overlap(
+                        config.comm_config(), world=self.comm.world,
+                        canonical=self.canonical_shards):
+                    self._comm_overlap = comm_overlap.OverlapScheduler()
 
         # datapipe (datapipe/ package): a "datapipe" config block swaps
         # the sync dataloader pull for the streaming/prefetching host
@@ -904,7 +915,8 @@ class Engine(ConfigAccessorsMixin):
                     loss, local = self._batch_grads_local(
                         state, batch, rng, gas)
                     grads, new_comm = self.comm.reduce_stacked(
-                        local, comm_state)
+                        local, comm_state,
+                        per_bucket=self._comm_overlap is not None)
                     grads = jax.tree.map(
                         lambda g: g.astype(self._grad_dtype), grads)
                     grads = partition.constrain(
@@ -1233,8 +1245,14 @@ class Engine(ConfigAccessorsMixin):
                         + ("reduced" if self._comm_acc_reduced else "local")
                         + " gradients")
                 if reduce_now:
+                    overlap = self._comm_overlap is not None
                     grads, self._comm_state = self.comm.reduce_dispatch(
-                        grads, self._comm_state)
+                        grads, self._comm_state, overlap=overlap)
+                    if overlap:
+                        # collectives stay in flight; step() drains at
+                        # the accumulation boundary
+                        self._comm_overlap.note(
+                            (grads, self._comm_state), self.comm.n_buckets)
             if self._grad_acc is None:
                 # bank the carry in the configured accumulation dtype (see
                 # grad_accum_dtype) so the imperative path matches
@@ -1264,8 +1282,19 @@ class Engine(ConfigAccessorsMixin):
                 # deferred routing (backward(allreduce_gradients=False)):
                 # the bank holds the SUM of local grad stacks; one bucketed
                 # reduction at the boundary covers the whole cycle
+                overlap = self._comm_overlap is not None
                 banked, self._comm_state = self.comm.reduce_dispatch(
-                    banked, self._comm_state)
+                    banked, self._comm_state, overlap=overlap)
+                if overlap:
+                    # async even here: buckets pipeline against each
+                    # other and the optimizer dispatch below
+                    self._comm_overlap.note(
+                        (banked, self._comm_state), self.comm.n_buckets)
+            if self._comm_overlap is not None:
+                # accumulation boundary: wait for every in-flight bucket
+                # under the comm/overlap_window span (the only comm time
+                # the overlap schedule leaves exposed)
+                self._comm_overlap.drain()
             # hand the optimizer grads in the storage dtype (the fused path
             # casts its scan carry back the same way)
             banked = jax.tree.map(

@@ -24,11 +24,30 @@ evidence — this measures, per reduction mode:
   * **step time** — fused ``train_batch`` mean wall time per mode.
   * **monitor wiring** — an imperative run with a "monitor" block must
     emit one ``comm/reduce`` span per bucket per cycle into a Chrome
-    trace that passes ``python -m deeperspeed_tpu.monitor.validate``,
-    and the ``comm_buckets`` / ``comm_wire_bytes`` counters must land
-    in the metrics registry.
+    trace that passes ``python -m deeperspeed_tpu.monitor.validate
+    --strict``, and the ``comm_buckets`` / ``comm_wire_bytes`` counters
+    must land in the metrics registry.
+  * **overlap fraction** — the monitor run happens twice, with the
+    ``comm.overlap`` knob off and on.  The serial trace prices each
+    reduction at its blocking dispatch cost; the overlapped trace only
+    pays the ``comm/overlap_window`` drain at the accumulation
+    boundary.  ``overlap_fraction = 1 - exposed/serial`` (see
+    runtime/comm/overlap.py) must be > 0: the schedule provably hides
+    comm behind backward even on this host.
 
-Acceptance bar: int8 ``per_step_x`` >= 4 at gas=2 with loss delta < 1%.
+Honesty notes baked into the output:
+
+  * every mode carries ``wire_basis: "measured"`` (compiled-HLO bytes);
+    when the analytic model disagrees (bf16: CPU lowering upcasts the
+    collective operand to f32, doubling measured bytes) the entry says
+    so in ``wire_caveat`` instead of silently preferring either number.
+  * step times are medians, and the ``timing`` block states that on a
+    single-core CPU mesh collectives are memcpys — quantization
+    arithmetic here COSTS the time it SAVES on a real interconnect, so
+    ``int8_vs_fp32_step`` is reported, not gated on.
+
+Acceptance bar: int8 ``per_step_x`` >= 4 at gas=2 with loss delta < 1%,
+strict-valid traces, and ``overlap_fraction`` > 0.
 Results go to BENCH_comm.json at the repo root.
 
 ``--onebit`` additionally regenerates ONEBIT_WIRE.json by delegating to
@@ -121,6 +140,11 @@ def _build_engine(comm, gas, monitor_trace=None):
         "train_batch_size": MICRO * gas * WORLD,
         "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
         "steps_per_print": 10 ** 9,
+        # auto routes the comm wire formats through the fused quantize/
+        # dequant formulation (ops/pallas/fused_quant: XLA route on this
+        # host, Pallas on TPU); bit-identical to the reference chain, so
+        # losses stay comparable across the kernels knob
+        "kernels": {"mode": "auto"},
     }
     if comm is not None:
         cfg["comm"] = comm
@@ -155,15 +179,24 @@ def measure_wire(comm, gas):
                 engine.comm._bucket_reduce_fn(j),
                 [leaves[i] for i in b.leaf_ids], engine._comm_state[j],
                 world=WORLD)["wire_total"])
+        modeled = engine.comm.total_wire_bytes()
         entry.update({
             "reduce_wire": reduce_wire,
-            "modeled_reduce_wire": engine.comm.total_wire_bytes(),
+            "modeled_reduce_wire": modeled,
             "n_buckets": engine.comm.n_buckets,
         })
         entry["per_step_wire"] = gas * fwd_wire + reduce_wire
+        entry["wire_basis"] = "measured"
+        if reduce_wire != modeled:
+            entry["wire_caveat"] = (
+                "compiled HLO disagrees with the analytic model: CPU "
+                "lowering upcasts the collective operand to f32 (bf16 "
+                "wire doubles); modeled_reduce_wire is what the "
+                "TPU-native collective moves")
     else:
         # the baseline all-reduces every microbatch's grads
         entry["per_step_wire"] = gas * fwd_wire
+        entry["wire_basis"] = "measured"
     return entry
 
 
@@ -180,21 +213,24 @@ def convergence_and_steptime(comm, gas, steps, warmup=3):
         if i >= warmup:
             losses.append(loss)
             times.append(dt)
+    # median, not mean: single measured steps on a shared CPU host see
+    # +-50% scheduler noise that a mean folds straight into the ratio
     return {
         "final_loss": losses[-1],
-        "step_ms": round(float(np.mean(times)) * 1e3, 3),
+        "step_ms": round(float(np.median(times)) * 1e3, 3),
     }
 
 
-def spans_and_metrics(comm, gas, cycles, workdir):
+def spans_and_metrics(comm, gas, cycles, workdir, overlap="off"):
     """Imperative run under a monitor block: comm/reduce spans must land
-    in a schema-valid trace, counters in the registry."""
-    import jax
-
+    in a strict-schema-valid trace, counters in the registry.  Returns
+    ``(summary, trace_events)`` so the caller can pair an overlap-off
+    trace with an overlap-on one for the overlap_fraction computation."""
     from deeperspeed_tpu.monitor import get_monitor, shutdown_monitor
 
-    trace_path = os.path.join(workdir, "trace_comm.json")
-    engine = _build_engine(comm, gas, monitor_trace=trace_path)
+    trace_path = os.path.join(workdir, f"trace_comm_{overlap}.json")
+    engine = _build_engine(dict(comm, overlap=overlap), gas,
+                           monitor_trace=trace_path)
     data = _make_batches(cycles * gas, MICRO * WORLD, seed=2)
     try:
         for c in range(cycles):
@@ -212,19 +248,27 @@ def spans_and_metrics(comm, gas, cycles, workdir):
         shutdown_monitor()
     proc = subprocess.run(
         [sys.executable, "-m", "deeperspeed_tpu.monitor.validate",
-         trace_path], capture_output=True, text=True)
+         "--strict", trace_path], capture_output=True, text=True)
     with open(trace_path) as f:
         raw = json.load(f)
     events = raw["traceEvents"] if isinstance(raw, dict) else raw
     spans = [e for e in events
              if e.get("name") == "comm/reduce" and e.get("ph") == "X"]
-    return {
+    windows = [e for e in events
+               if e.get("name") == "comm/overlap_window"]
+    summary = {
+        "overlap": overlap,
         "validate_rc": proc.returncode,
-        "validate_errors": proc.stderr.strip().splitlines()[:5],
+        "validate_errors": (proc.stderr.strip().splitlines()[:5]
+                            if proc.returncode else []),
         "comm_reduce_spans": len(spans),
         "expected_spans": n_buckets * cycles,
+        "overlapped_spans": sum(
+            1 for e in spans if e.get("args", {}).get("overlapped")),
+        "overlap_windows": len(windows),
         "counters": counters,
     }
+    return summary, events
 
 
 def main():
@@ -279,25 +323,64 @@ def main():
         with open(args.out, "w") as f:  # persist after every entry
             json.dump(result, f, indent=1)
 
+    from deeperspeed_tpu.ops.pallas import fused_quant
+    from deeperspeed_tpu.runtime.comm import overlap as comm_overlap
+
+    result["kernels"] = {"mode": "auto",
+                         "fused_quant_route": fused_quant.routing()[0]}
+
     with tempfile.TemporaryDirectory() as workdir:
-        result["monitor"] = spans_and_metrics(
-            MODES["int8"], gas, cycles=3, workdir=workdir)
+        mon, serial_events = spans_and_metrics(
+            MODES["int8"], gas, cycles=3, workdir=workdir, overlap="off")
+        mon_on, overlap_events = spans_and_metrics(
+            MODES["int8"], gas, cycles=3, workdir=workdir, overlap="on")
+    result["monitor"] = mon
+    stats_off = comm_overlap.reduce_span_stats(serial_events)
+    stats_on = comm_overlap.reduce_span_stats(overlap_events)
+    result["overlap"] = {
+        "off": mon,
+        "on": mon_on,
+        "serial_reduce_ms": round(stats_off["reduce_ms"], 3),
+        "exposed_window_ms": round(stats_on["window_ms"], 3),
+        "overlap_fraction": round(
+            comm_overlap.overlap_fraction(serial_events, overlap_events),
+            4),
+    }
     print("monitor", json.dumps(result["monitor"]), flush=True)
+    print("overlap", json.dumps(result["overlap"]), flush=True)
 
     i8 = result["modes"]["int8"]
-    mon = result["monitor"]
+    fp32_ms = result["modes"]["fp32"]["step_ms"]
+    result["timing"] = {
+        "basis": "wall_clock_median",
+        "int8_vs_fp32_step": round(i8["step_ms"] / fp32_ms, 3),
+        "caveat": (
+            "single-core host, 8 virtual XLA devices: collectives are "
+            "memcpys here, so the quantize/dequant arithmetic COSTS the "
+            "wall time it SAVES on a real interconnect; the wire ratios "
+            "above are the transferable evidence, this ratio is the "
+            "honest local reading"),
+    }
+    ovl = result["overlap"]
     result["pass"] = bool(
         i8["per_step_x"] >= 4.0
         and i8["loss_delta_pct"] < 1.0
         and mon["validate_rc"] == 0
+        and ovl["on"]["validate_rc"] == 0
         and mon["comm_reduce_spans"] == mon["expected_spans"]
-        and mon["counters"]["comm_buckets"] > 0)
+        and ovl["on"]["comm_reduce_spans"] == ovl["on"]["expected_spans"]
+        and ovl["on"]["overlapped_spans"] == ovl["on"]["comm_reduce_spans"]
+        and mon["counters"]["comm_buckets"] > 0
+        and ovl["overlap_fraction"] > 0.0)
     with open(args.out, "w") as f:
         json.dump(result, f, indent=1)
     print(json.dumps({"pass": result["pass"],
                       "int8_per_step_x": i8["per_step_x"],
                       "int8_reduce_only_x": i8["reduce_only_x"],
-                      "int8_loss_delta_pct": i8["loss_delta_pct"]}),
+                      "int8_loss_delta_pct": i8["loss_delta_pct"],
+                      "overlap_fraction": ovl["overlap_fraction"],
+                      "int8_vs_fp32_step":
+                          result["timing"]["int8_vs_fp32_step"]}),
           flush=True)
 
     if args.onebit:

@@ -569,8 +569,10 @@ def test_pipe_engine_comm_transform():
 @pytest.mark.slow
 def test_comm_bench_full(tmp_path):
     """Full scripts/comm_bench.py run: int8 must cut per-step wire bytes
-    >= 4x vs the fp32 baseline at gas=2 with < 1% final-loss delta, and
-    the comm/reduce spans must land in a schema-valid trace."""
+    >= 4x vs the fp32 baseline at gas=2 with < 1% final-loss delta, the
+    comm/reduce spans must land in a strict-schema-valid trace, and the
+    overlap-on pass must prove a positive overlap fraction end-to-end
+    (fused quant routing included: the bench runs under kernels auto)."""
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     out = str(tmp_path / "BENCH_comm.json")
     proc = subprocess.run(
@@ -585,6 +587,20 @@ def test_comm_bench_full(tmp_path):
     i8 = report["modes"]["int8"]
     assert i8["per_step_x"] >= 4.0
     assert i8["loss_delta_pct"] < 1.0
+    assert i8["wire_basis"] == "measured"
+    # bf16's measured/modeled disagreement must carry its caveat
+    assert "wire_caveat" in report["modes"]["bf16"]
     assert report["monitor"]["validate_rc"] == 0
     assert (report["monitor"]["comm_reduce_spans"]
             == report["monitor"]["expected_spans"])
+    # overlap end-to-end: bench runs the monitored loop with the knob
+    # off and on; the on-pass spans must all be overlapped, the drain
+    # windows present, and the two-trace fraction positive
+    ovl = report["overlap"]
+    assert ovl["on"]["validate_rc"] == 0
+    assert ovl["on"]["overlapped_spans"] == ovl["on"]["comm_reduce_spans"]
+    assert ovl["on"]["overlap_windows"] > 0
+    assert ovl["off"]["overlapped_spans"] == 0
+    assert ovl["overlap_fraction"] > 0.0
+    assert report["kernels"]["fused_quant_route"] in ("xla", "pallas")
+    assert report["timing"]["int8_vs_fp32_step"] > 0.0
