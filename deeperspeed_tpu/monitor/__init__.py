@@ -33,6 +33,13 @@ from .metrics import (
     MetricsServer,
     export_to_tensorboard,
 )
+from .memwatch import MemWatch, aggregate_memory_stats, device_memory_stats
+from .perf import (
+    CompiledCostIndex,
+    extract_cost_analysis,
+    extract_memory_analysis,
+    platform_peaks,
+)
 from .runctx import RunContext, current as current_run_context, ensure_run_id
 from .tracer import (
     Tracer,
@@ -55,6 +62,13 @@ __all__ = [
     "RunContext",
     "RecompileError",
     "RecompileWatchdog",
+    "CompiledCostIndex",
+    "MemWatch",
+    "aggregate_memory_stats",
+    "device_memory_stats",
+    "extract_cost_analysis",
+    "extract_memory_analysis",
+    "platform_peaks",
     "current_run_context",
     "ensure_run_id",
     "export_to_tensorboard",
@@ -109,6 +123,15 @@ class Monitor:
         else:
             self.tracer = None
         self.watchdog = RecompileWatchdog(mode=cfg.watchdog)
+        # perf doctor legs: compiled-cost index (opt-in — its live MFU
+        # readout syncs the step inside the span) and the device-memory
+        # watermark lane (near-free, defaults on with tracing)
+        self.cost_index: Optional[CompiledCostIndex] = (
+            CompiledCostIndex(registry=self.registry) if cfg.perf else None)
+        self.memwatch: Optional[MemWatch] = (
+            MemWatch(registry=self.registry,
+                     near_oom_fraction=cfg.near_oom_fraction)
+            if cfg.memwatch and cfg.trace_enabled else None)
         self.metrics_server: Optional[MetricsServer] = None
         if cfg.metrics_port is not None:
             self.metrics_server = MetricsServer(

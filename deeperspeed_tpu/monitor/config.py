@@ -37,6 +37,7 @@ _KNOWN_KEYS = frozenset({
     "enabled", "trace_enabled", "trace_path", "ring_size", "watchdog",
     "metrics_port", "metrics_host", "tb_export_interval",
     "flight_path", "flight_records", "flight_slot_bytes", "obs_dir",
+    "perf", "memwatch", "near_oom_fraction",
 })
 
 
@@ -69,6 +70,17 @@ class MonitorConfig:
     # run-scoped output directory: derives trace_path/flight_path from
     # the process's role + incarnation when they are not set explicitly
     obs_dir: Optional[str] = None
+    # perf doctor (monitor/perf.py): compiled-cost captures + live MFU
+    # span args. Opt-in: the MFU readout syncs the step result inside
+    # the train-batch span, an observer effect the default must not pay
+    perf: bool = False
+    # device-memory watermark lane (monitor/memwatch.py): ~free (CPU
+    # reads {}; TPU reads the allocator ledger), so on by default
+    # wherever tracing is on
+    memwatch: bool = True
+    # bytes_in_use/bytes_limit fraction that trips the near-OOM
+    # post-mortem (top-K live buffers through the flight recorder)
+    near_oom_fraction: float = 0.92
 
     def __post_init__(self):
         if self.ring_size < 1:
@@ -92,6 +104,10 @@ class MonitorConfig:
             raise ValueError(
                 f"tb_export_interval must be >= 0, got "
                 f"{self.tb_export_interval}")
+        if not (0.0 < self.near_oom_fraction <= 1.0):
+            raise ValueError(
+                f"near_oom_fraction must be in (0, 1], got "
+                f"{self.near_oom_fraction}")
 
     @classmethod
     def from_dict(cls, d: Optional[dict]) -> "MonitorConfig":

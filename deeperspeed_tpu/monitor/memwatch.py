@@ -1,0 +1,209 @@
+"""Device-memory watermark lane + near-OOM post-mortem.
+
+TPU runtimes expose an allocator ledger per device
+(``device.memory_stats()``: ``bytes_in_use`` / ``peak_bytes_in_use`` /
+``bytes_limit``); CPU returns ``None``. Before this module the repo
+read that ledger in two hand-rolled places (``runtime/utils.py`` and
+``utils/timer.py``) and nowhere near the trace. Now:
+
+  * :func:`device_memory_stats` / :func:`aggregate_memory_stats` are
+    the one normalized reader (``{}`` on backends with no ledger) that
+    both legacy call sites delegate to;
+  * :class:`MemWatch` samples the ledger at phase boundaries — one
+    ``mem/watermark`` instant plus gauges per sample, and a
+    ``span.note(hbm_in_use=…, hbm_peak=…)`` helper so the fwd / bwd /
+    step / prefill / decode spans carry their watermark;
+  * when ``bytes_in_use`` crosses ``near_oom_fraction`` of
+    ``bytes_limit`` it fires a post-mortem: the top-K live buffers
+    (shape / dtype / nbytes / sharding, via ``jax.live_arrays()``)
+    emitted as compact instants that ride the tracer's inline flight
+    sink — so a process the allocator kills moments later still leaves
+    an explanation in ``flight.bin``.
+
+Everything degrades to near-free on CPU: stats are ``{}``, watermarks
+are zeros (so the span args and trace schema stay identical across
+backends, which is what keeps the CPU tests honest), and the
+post-mortem only auto-fires where a ``bytes_limit`` exists.
+"""
+
+import threading
+from typing import Any, Dict, List, Optional
+
+from ..utils.logging import logger
+from .tracer import trace_instant
+
+__all__ = [
+    "MemWatch",
+    "aggregate_memory_stats",
+    "device_memory_stats",
+]
+
+# the allocator ledger keys we normalize (ints, bytes)
+_STAT_KEYS = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+              "largest_free_block_bytes", "num_allocs")
+
+
+def device_memory_stats(device=None) -> Dict[str, int]:
+    """``device.memory_stats()`` normalized to ints; ``{}`` when the
+    backend has no allocator ledger (CPU) or no device exists at all."""
+    if device is None:
+        try:
+            import jax
+            device = jax.local_devices()[0]
+        except Exception:  # pragma: no cover - no backend
+            return {}
+    try:
+        raw = device.memory_stats()
+    except Exception:  # pragma: no cover - defensive
+        return {}
+    if not raw:
+        return {}
+    out: Dict[str, int] = {}
+    for k in _STAT_KEYS:
+        v = raw.get(k)
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            out[k] = int(v)
+    return out
+
+
+def aggregate_memory_stats() -> Dict[str, int]:
+    """Ledger summed across local devices; ``{}`` when every device is
+    silent (so callers can distinguish "no ledger" from "zero bytes")."""
+    try:
+        import jax
+        devices = jax.local_devices()
+    except Exception:  # pragma: no cover - no backend
+        return {}
+    total: Dict[str, int] = {}
+    backed = False
+    for d in devices:
+        s = device_memory_stats(d)
+        if not s:
+            continue
+        backed = True
+        for k, v in s.items():
+            if k == "largest_free_block_bytes":
+                total[k] = max(total.get(k, 0), v)
+            else:
+                total[k] = total.get(k, 0) + v
+    return total if backed else {}
+
+
+class MemWatch:
+    """Watermark sampler + near-OOM post-mortem (see module docstring).
+
+    ``sample(phase)`` is the phase-boundary hook: one ``mem/watermark``
+    instant (zeros on CPU — the lane exists on every backend) plus the
+    ``mem_bytes_in_use`` / ``mem_peak_bytes`` gauges, and the near-OOM
+    trip check. ``annotate(span, phase)`` additionally stamps the
+    enclosing span with ``hbm_in_use`` / ``hbm_peak`` args."""
+
+    def __init__(self, registry=None, near_oom_fraction: float = 0.92,
+                 top_k: int = 8):
+        if not (0.0 < near_oom_fraction <= 1.0):
+            raise ValueError(
+                f"near_oom_fraction must be in (0, 1], got {near_oom_fraction}")
+        self._registry = registry
+        self.near_oom_fraction = near_oom_fraction
+        self.top_k = top_k
+        self._lock = threading.Lock()
+        self._armed = True         # re-arms when usage falls back under
+        self.postmortems = 0       # how many times the dump fired
+
+    # -- sampling ----------------------------------------------------- #
+
+    def sample(self, phase: str) -> Dict[str, int]:
+        stats = aggregate_memory_stats()
+        in_use = stats.get("bytes_in_use", 0)
+        peak = stats.get("peak_bytes_in_use", 0)
+        limit = stats.get("bytes_limit", 0)
+        trace_instant("mem/watermark", lane="mem", phase=phase,
+                      bytes_in_use=in_use, peak_bytes=peak,
+                      **({"bytes_limit": limit} if limit else {}))
+        if self._registry is not None:
+            self._registry.gauge(
+                "mem_bytes_in_use",
+                "device allocator: live bytes across local devices",
+            ).set(float(in_use))
+            self._registry.gauge(
+                "mem_peak_bytes",
+                "device allocator: peak live bytes across local devices",
+            ).set(float(peak))
+        if limit > 0:
+            frac = in_use / limit
+            with self._lock:
+                fire = self._armed and frac >= self.near_oom_fraction
+                if fire:
+                    self._armed = False
+                elif frac < 0.75 * self.near_oom_fraction:
+                    self._armed = True
+            if fire:
+                self.post_mortem(
+                    reason=f"near-oom at {phase}: "
+                           f"{frac:.1%} of bytes_limit", stats=stats)
+        return stats
+
+    def annotate(self, span, phase: str) -> Dict[str, int]:
+        """sample() + watermark args on the enclosing span (works on the
+        null span too — note() is a no-op there)."""
+        stats = self.sample(phase)
+        span.note(hbm_in_use=stats.get("bytes_in_use", 0),
+                  hbm_peak=stats.get("peak_bytes_in_use", 0))
+        return stats
+
+    # -- post-mortem --------------------------------------------------- #
+
+    def live_buffers(self, top_k: Optional[int] = None) -> List[Dict[str, Any]]:
+        """Top-K live device buffers by size: shape / dtype / nbytes /
+        sharding. Pure inspection — safe to call anywhere."""
+        try:
+            import jax
+            arrays = jax.live_arrays()
+        except Exception:  # pragma: no cover - no backend
+            return []
+        rows: List[Dict[str, Any]] = []
+        for x in arrays:
+            try:
+                rows.append({
+                    "shape": "x".join(str(s) for s in x.shape) or "scalar",
+                    "dtype": str(x.dtype),
+                    "nbytes": int(x.nbytes),
+                    "sharding": str(getattr(x, "sharding", "?")),
+                })
+            except Exception:  # deleted/donated mid-iteration
+                continue
+        rows.sort(key=lambda r: r["nbytes"], reverse=True)
+        return rows[: top_k if top_k is not None else self.top_k]
+
+    def post_mortem(self, reason: str,
+                    stats: Optional[Dict[str, int]] = None) -> Dict[str, Any]:
+        """Dump the allocation picture into the trace. Each buffer is its
+        own compact ``mem/buffer`` instant (small enough for one flight
+        slot each — a 512 B slot cannot hold the whole table), headed by
+        one ``mem/postmortem`` summary; the tracer's inline flight sink
+        makes the dump SIGKILL-proof. Returns the payload for callers
+        (tests, the OOM handler) that want it in hand."""
+        if stats is None:
+            stats = aggregate_memory_stats()
+        buffers = self.live_buffers()
+        payload = {
+            "reason": reason,
+            "bytes_in_use": stats.get("bytes_in_use", 0),
+            "bytes_limit": stats.get("bytes_limit", 0),
+            "live_buffers": len(buffers),
+            "buffers": buffers,
+        }
+        trace_instant("mem/postmortem", lane="mem", reason=reason,
+                      bytes_in_use=payload["bytes_in_use"],
+                      bytes_limit=payload["bytes_limit"],
+                      buffers=len(buffers))
+        for rank, b in enumerate(buffers):
+            trace_instant("mem/buffer", lane="mem", rank=rank,
+                          shape=b["shape"], dtype=b["dtype"],
+                          nbytes=b["nbytes"], sharding=b["sharding"])
+        with self._lock:
+            self.postmortems += 1
+        logger.warning("memwatch: post-mortem (%s): %d live buffers, "
+                       "%.2f GB in use", reason, len(buffers),
+                       payload["bytes_in_use"] / 2**30)
+        return payload

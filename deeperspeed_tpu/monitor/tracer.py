@@ -70,6 +70,9 @@ class _NullSpan:
     def __exit__(self, *exc):
         return False
 
+    def note(self, **args):
+        return self
+
 
 _NULL_SPAN = _NullSpan()
 
@@ -87,6 +90,16 @@ class _Span:
 
     def __enter__(self):
         self._t0 = time.perf_counter()
+        return self
+
+    def note(self, **args):
+        """Attach args discovered mid-span (MFU, HBM watermarks — values
+        that only exist once the work ran); merged into the "X" event at
+        exit. Returns self so call sites can chain."""
+        if self._args:
+            self._args.update(args)
+        else:
+            self._args = args
         return self
 
     def __exit__(self, *exc):
@@ -126,6 +139,7 @@ class Tracer:
         self.on_drop = on_drop
         self.clock = clock_anchor()     # (wall, perf) for trace merging
         self._last_drop_note = float("-inf")
+        self._extra_meta: Dict[str, object] = {}
 
     # -------------------------------------------------------------- #
     # recording
@@ -243,8 +257,16 @@ class Tracer:
             })
         return meta
 
+    def set_metadata(self, key: str, value) -> None:
+        """Stamp a JSON-ready blob into the saved trace's ``otherData``
+        (e.g. the perf layer's compiled-cost table); last write wins."""
+        with self._lock:
+            self._extra_meta[key] = value
+
     def to_dict(self) -> dict:
         other = {"dropped_events": self.dropped, "clock": dict(self.clock)}
+        with self._lock:
+            other.update(self._extra_meta)
         if self.run_context is not None:
             other["run"] = self.run_context.as_args()
         return {

@@ -65,6 +65,14 @@ EVENT_ARG_SCHEMAS = {
     # on exactly these spans
     "comm/reduce": ("bucket", "mode"),
     "comm/overlap_window": ("buckets",),
+    # perf doctor: compiled-cost captures, live per-step MFU, and the
+    # device-memory watermark lane — PERF_LEDGER tooling and the
+    # roofline readout join on these
+    "perf/compiled": ("entry", "flops", "bytes", "peak_hbm"),
+    "perf/step": ("entry", "mfu", "wall_ms", "verdict"),
+    "mem/watermark": ("phase", "bytes_in_use", "peak_bytes"),
+    "mem/postmortem": ("reason", "bytes_in_use", "buffers"),
+    "mem/buffer": ("rank", "shape", "dtype", "nbytes", "sharding"),
 }
 
 # strict-mode name discipline: one prefix per subsystem that emits
@@ -72,7 +80,7 @@ EVENT_ARG_SCHEMAS = {
 KNOWN_EVENT_PREFIXES = (
     "engine/", "pipe/", "offload/", "comm/", "kernels/", "datapipe/",
     "resilience/", "serving/", "flight/", "run/", "goodput/", "trace/",
-    "monitor/",
+    "monitor/", "perf/", "mem/",
 )
 KNOWN_EVENT_NAMES = frozenset({
     "xla_compile", "recompile!", "process_name", "thread_name",

@@ -184,9 +184,15 @@ class FlopsProfiler:
         jitted = jax.jit(fn)
         lowered = jitted.lower(*args, **kwargs)
         compiled = lowered.compile()
-        ca = compiled.cost_analysis() or {}
-        self._flops = int(ca.get("flops", 0))
-        self._bytes = float(ca.get("bytes accessed", 0.0))
+        # cost_analysis() is None on backends without a cost model, a
+        # list of per-computation dicts on some jaxlibs, and a partial
+        # dict elsewhere — the monitor's extractor is the one place
+        # that mess is normalized
+        from ...monitor.perf import extract_cost_analysis
+
+        ca = extract_cost_analysis(compiled)
+        self._flops = int(ca["flops"])
+        self._bytes = float(ca["bytes_accessed"])
         self._per_primitive = flops_of_jaxpr(jax.make_jaxpr(fn)(*args, **kwargs))
         if self._flops == 0:  # backend without a cost model
             self._flops = sum(self._per_primitive.values())

@@ -666,16 +666,24 @@ class GradReducer:
                 f"was built for {self.plan.n_leaves}")
         outs = [None] * self.plan.n_leaves
         new_state = []
+        from ...monitor import get_monitor
+        _mon = get_monitor()
+        _ci = _mon.cost_index if _mon is not None else None
         for j, b in enumerate(self.plan.buckets):
             fn = self._bucket_reduce_fn(j)
             wire = self.bucket_wire_bytes(b)
             with trace_span("comm/reduce", lane="comm", bucket=j,
                             mode=self.cfg.mode, elements=b.length,
                             wire_bytes=wire, overlapped=bool(overlap)):
-                bucket_out, nr = fn([leaves[i] for i in b.leaf_ids],
-                                    state[j])
+                _bargs = ([leaves[i] for i in b.leaf_ids], state[j])
+                bucket_out, nr = fn(*_bargs)
                 if not overlap:
                     bucket_out = jax.block_until_ready(bucket_out)
+                if _ci is not None:
+                    # per-bucket compiled cost (flops ~0, bytes = wire
+                    # math): what the roofline needs to price the
+                    # collective leg against compute
+                    _ci.observe(f"comm/reduce[b{j}]", fn, _bargs)
             for i, leaf in zip(b.leaf_ids, bucket_out):
                 outs[i] = leaf
             new_state.append(nr)

@@ -23,6 +23,7 @@ nn.Module whose forward returns the loss.
 
 import inspect
 import os
+import time
 from typing import Any, Callable, NamedTuple, Optional
 
 import jax
@@ -1206,8 +1207,19 @@ class Engine(ConfigAccessorsMixin):
         if wall:
             self._timer_start(FORWARD_MICRO_TIMER)
         with trace_span("engine/forward", lane="engine",
-                        micro_step=self.micro_steps):
-            loss, grads = self._forward_grad_fn()(self.state, batch, rng)
+                        micro_step=self.micro_steps) as _sp:
+            fwd_fn = self._forward_grad_fn()
+            loss, grads = fwd_fn(self.state, batch, rng)
+            mon = self.monitor
+            if mon is not None:
+                if mon.cost_index is not None:
+                    # imperative-path cost capture: AOT re-lower against
+                    # abstract avals, so the jit cache (and the
+                    # watchdog's view of it) is untouched
+                    mon.cost_index.observe("engine/forward_grad", fwd_fn,
+                                           (self.state, batch, rng))
+                if mon.memwatch is not None:
+                    mon.memwatch.annotate(_sp, "forward")
         if wall:
             # forward+backward are fused in this fn; the split is the
             # imperative API's, the timing is the fused step's
@@ -1233,7 +1245,7 @@ class Engine(ConfigAccessorsMixin):
         self._last_micro_loss = stashed_loss  # for step()-path monitoring
         self._stashed = None
         with trace_span("engine/backward", lane="engine",
-                        micro_step=self.micro_steps):
+                        micro_step=self.micro_steps) as _bwd_sp:
             if self.comm is not None:
                 reduce_now = bool(allreduce_gradients)
                 if self._grad_acc is None:
@@ -1264,6 +1276,9 @@ class Engine(ConfigAccessorsMixin):
                 self._grad_acc = jax.tree.map(
                     lambda a, g: a + g.astype(a.dtype), self._grad_acc, grads
                 )
+            if (self.monitor is not None
+                    and self.monitor.memwatch is not None):
+                self.monitor.memwatch.annotate(_bwd_sp, "backward")
         self._acc_count += 1
         return loss
 
@@ -1301,7 +1316,8 @@ class Engine(ConfigAccessorsMixin):
                 lambda g: g.astype(self._grad_dtype), banked
             )
             with trace_span("engine/step", lane="engine",
-                            step=self.global_steps):
+                            step=self.global_steps) as _step_sp:
+                mon = self.monitor
                 if self._offload is not None:
                     grads, gnorm, finite = self._offload_post_fn()(
                         self.state, banked, np.float32(self._acc_count)
@@ -1311,10 +1327,18 @@ class Engine(ConfigAccessorsMixin):
                     lr = np.float32(self._current_lr())
                     # the imperative path banked unscaled-by-gas grads;
                     # scale in fn
-                    new_state, metrics = self._apply_update_fn()(
+                    upd_fn = self._apply_update_fn()
+                    if mon is not None and mon.cost_index is not None:
+                        mon.cost_index.observe(
+                            "engine/apply_update", upd_fn,
+                            (self.state, banked, lr,
+                             np.float32(self._acc_count)))
+                    new_state, metrics = upd_fn(
                         self.state, banked, lr, np.float32(self._acc_count)
                     )
                     self.state = new_state
+                if mon is not None and mon.memwatch is not None:
+                    mon.memwatch.annotate(_step_sp, "step")
             if self.store_gradients:
                 self._store_grads(banked)
             self._grad_acc = None
@@ -1419,8 +1443,12 @@ class Engine(ConfigAccessorsMixin):
         if self._layer_collector is not None:
             self._layer_collector.clear()
         wd = self.monitor.watchdog if self.monitor is not None else None
+        ci = self.monitor.cost_index if self.monitor is not None else None
+        mw = self.monitor.memwatch if self.monitor is not None else None
+        step_fn = step_args = None  # what the perf doctor re-lowers
         with trace_span("engine/train_batch", lane="engine",
-                        step=self.global_steps):
+                        step=self.global_steps) as _tb_sp:
+            _t0 = time.perf_counter()
             if self._offload is not None:
                 loss, grads, gnorm, finite = self._offload_grads_fn()(
                     self.state, batch, rng
@@ -1442,12 +1470,30 @@ class Engine(ConfigAccessorsMixin):
                 if wd is not None:
                     wd.watch("engine/train_step", fn)
                 if self.comm is not None:
-                    new_state, self._comm_state, metrics = fn(
-                        self.state, self._comm_state, batch, lr, rng)
+                    step_args = (self.state, self._comm_state, batch, lr, rng)
+                    new_state, self._comm_state, metrics = fn(*step_args)
                     self.comm.record_reduction_counters()
                 else:
-                    new_state, metrics = fn(self.state, batch, lr, rng)
+                    step_args = (self.state, batch, lr, rng)
+                    new_state, metrics = fn(*step_args)
+                step_fn = fn
                 self.state = new_state
+            if ci is not None and step_fn is not None:
+                # perf doctor is opt-in precisely because of this sync:
+                # per-step MFU needs the real wall time, so the step
+                # result is blocked on INSIDE the span (the default
+                # path stays fully async — ThroughputTimer only syncs
+                # on reporting steps)
+                jax.block_until_ready(metrics["loss"])
+                _wall = time.perf_counter() - _t0
+                ci.observe("engine/train_step", step_fn, step_args)
+                _stats = ci.note_step("engine/train_step", _wall)
+                if _stats is not None:
+                    _tb_sp.note(mfu=round(_stats["mfu"], 6),
+                                tflops=round(_stats["tflops"], 4),
+                                verdict=_stats["verdict"])
+            if mw is not None:
+                mw.annotate(_tb_sp, "train_batch")
         if wd is not None:
             # the train step must compile once (after sharding commits,
             # see __init__) and stay compiled; cache growth past the warm

@@ -138,13 +138,13 @@ def clip_by_global_norm(tree, max_norm: float, norm: Optional[jnp.ndarray] = Non
 
 def memory_status() -> Dict[str, int]:
     """Per-device memory stats where the backend exposes them (TPU does;
-    CPU returns zeros)."""
-    stats: Dict[str, int] = {"bytes_in_use": 0, "peak_bytes_in_use": 0}
-    for d in jax.local_devices():
-        s = d.memory_stats() or {}
-        stats["bytes_in_use"] += int(s.get("bytes_in_use", 0))
-        stats["peak_bytes_in_use"] += int(s.get("peak_bytes_in_use", 0))
-    return stats
+    CPU returns zeros). Delegates to the monitor's normalized reader —
+    this keeps the historical zeros-dict shape for existing callers."""
+    from ..monitor.memwatch import aggregate_memory_stats
+
+    agg = aggregate_memory_stats()
+    return {"bytes_in_use": agg.get("bytes_in_use", 0),
+            "peak_bytes_in_use": agg.get("peak_bytes_in_use", 0)}
 
 
 def see_memory_usage(message: str, force: bool = False):

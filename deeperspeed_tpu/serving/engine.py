@@ -399,13 +399,13 @@ class ServingEngine(_ServingBase):
         L = len(ctx)
         bucket = self.scfg.bucket_for(L)
         with trace_span("serving/prefill", lane="serving", rid=req.rid,
-                        slot=slot, ctx_len=L, bucket=bucket):
+                        slot=slot, ctx_len=L, bucket=bucket) as _sp:
             timer = self.metrics.timers(PREFILL_TIMER)
             timer.safe_start()
             toks = np.zeros((1, bucket), np.int32)
             toks[0, :L] = ctx
-            logits, cache = self._prefill_step(self.params,
-                                               jnp.asarray(toks))
+            _pargs = (self.params, jnp.asarray(toks))
+            logits, cache = self._prefill_step(*_pargs)
             # admission allocated headroom for the first decode write;
             # only the context's own pages carry prefill data
             data_blocks = blocks[:blocks_needed(L, self.scfg.block_size)]
@@ -413,6 +413,16 @@ class ServingEngine(_ServingBase):
             tok = self._pick_token(logits[0, L - 1], req)
             req.generated.append(tok)
             timer.stop(sync_with=self.kv.k)
+            tel = self.telemetry
+            if tel is not None:
+                if tel.cost_index is not None:
+                    # per-bucket: the prefill jit legitimately holds one
+                    # compile per context-length bucket
+                    tel.cost_index.observe(
+                        f"serving/prefill_step[b{bucket}]",
+                        self._prefill_step, _pargs)
+                if tel.memwatch is not None:
+                    tel.memwatch.annotate(_sp, "prefill")
         logger.debug("serving: admitted %s to slot %d (ctx=%d bucket=%d)",
                      req.rid, slot, L, bucket)
         self._record_emitted(req, prefill=True)
@@ -438,16 +448,32 @@ class ServingEngine(_ServingBase):
             seeds[s] = req.seed
             counts[s] = len(req.generated)
         with trace_span("serving/decode", lane="serving",
-                        n_active=len(active)):
+                        n_active=len(active)) as _sp:
+            _t0 = time.perf_counter()
             timer = self.metrics.timers(DECODE_TIMER)
             timer.safe_start()
-            nxt, self.kv.k, self.kv.v = self._decode_step(
-                self.params, self.kv.k, self.kv.v, jnp.asarray(tables),
-                jnp.asarray(lengths), jnp.asarray(tokens),
-                jnp.asarray(temps), jnp.asarray(seeds),
-                jnp.asarray(counts))
+            _dargs = (self.params, self.kv.k, self.kv.v, jnp.asarray(tables),
+                      jnp.asarray(lengths), jnp.asarray(tokens),
+                      jnp.asarray(temps), jnp.asarray(seeds),
+                      jnp.asarray(counts))
+            nxt, self.kv.k, self.kv.v = self._decode_step(*_dargs)
             nxt = np.asarray(nxt)                   # device sync
             timer.stop()
+            tel = self.telemetry
+            if tel is not None:
+                if tel.cost_index is not None:
+                    # the sync above already happened, so this wall time
+                    # is real; the AOT re-lower never touches the decode
+                    # jit's cache (one-compile decode stays one-compile)
+                    tel.cost_index.observe("serving/decode_step",
+                                           self._decode_step, _dargs)
+                    _stats = tel.cost_index.note_step(
+                        "serving/decode_step", time.perf_counter() - _t0)
+                    if _stats is not None:
+                        _sp.note(mfu=round(_stats["mfu"], 6),
+                                 verdict=_stats["verdict"])
+                if tel.memwatch is not None:
+                    tel.memwatch.annotate(_sp, "decode")
         if self.telemetry is not None:
             self.telemetry.watchdog.observe("serving/decode_step",
                                             step=self._step_i)

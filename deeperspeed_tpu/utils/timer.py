@@ -85,9 +85,9 @@ class SynchronizedWallClockTimer:
     @staticmethod
     def memory_usage():
         try:
-            import jax
+            from ..monitor.memwatch import device_memory_stats
 
-            stats = jax.local_devices()[0].memory_stats() or {}
+            stats = device_memory_stats()
             alloc = stats.get("bytes_in_use", 0) / (1024**3)
             peak = stats.get("peak_bytes_in_use", 0) / (1024**3)
             return f"Memory: {alloc:.2f} GB in use | {peak:.2f} GB peak"

@@ -1,0 +1,469 @@
+"""Perf doctor tests: compiled-cost index capture on CPU jits, the
+device-memory watermark lane (graceful ``{}``-on-CPU fallback, spans
+carrying hbm args), the flight-recorded near-OOM post-mortem payload,
+the perf-regression ledger (append/check round-trip, seeded-regression
+non-zero exit), and the engine/serving integration (train-batch and
+decode spans carrying ``mfu``/``hbm_peak`` on CPU, strict-valid trace,
+decode still one-compile with the perf layer on)."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deeperspeed_tpu as deepspeed
+from deeperspeed_tpu.monitor import (
+    CompiledCostIndex,
+    MemWatch,
+    Tracer,
+    aggregate_memory_stats,
+    device_memory_stats,
+    get_monitor,
+    init_monitor,
+    set_tracer,
+    shutdown_monitor,
+    validate_events,
+)
+from deeperspeed_tpu.monitor import flight as flight_mod
+from deeperspeed_tpu.monitor.ledger import (
+    METRIC_SPECS,
+    MetricSpec,
+    PerfLedger,
+    collect_current,
+    main as ledger_main,
+)
+from deeperspeed_tpu.monitor.perf import (
+    extract_cost_analysis,
+    extract_memory_analysis,
+    platform_peaks,
+)
+from deeperspeed_tpu.runtime.utils import memory_status
+from deeperspeed_tpu.utils.timer import SynchronizedWallClockTimer
+
+
+@pytest.fixture(autouse=True)
+def _clean_global_monitor():
+    """Telemetry state is process-global; leave no tracer/monitor behind."""
+    yield
+    shutdown_monitor(save=False)
+    set_tracer(None)
+
+
+# ------------------------------------------------------------------ #
+# cost extraction + index
+# ------------------------------------------------------------------ #
+
+
+def _compiled(fn, *args):
+    return jax.jit(fn).lower(*args).compile()
+
+
+def test_extract_cost_analysis_real_jit():
+    c = _compiled(lambda x: (x @ x).sum(), jnp.ones((32, 32)))
+    ca = extract_cost_analysis(c)
+    assert set(ca) == {"flops", "bytes_accessed", "optimal_seconds"}
+    assert ca["flops"] > 0  # 32^3-ish matmul definitely counts flops
+    assert ca["bytes_accessed"] > 0
+
+
+def test_extract_cost_analysis_degenerate_shapes():
+    class Fake:
+        def __init__(self, ret):
+            self._ret = ret
+
+        def cost_analysis(self):
+            if isinstance(self._ret, Exception):
+                raise self._ret
+            return self._ret
+
+    zero = {"flops": 0.0, "bytes_accessed": 0.0, "optimal_seconds": 0.0}
+    assert extract_cost_analysis(Fake(None)) == zero
+    assert extract_cost_analysis(Fake([])) == zero
+    assert extract_cost_analysis(Fake("bogus")) == zero
+    assert extract_cost_analysis(Fake(RuntimeError("no model"))) == zero
+    # list-of-dicts (what this CPU backend actually returns) + partial keys
+    got = extract_cost_analysis(Fake([{"flops": 7.0}]))
+    assert got["flops"] == 7.0 and got["bytes_accessed"] == 0.0
+    # negative sentinel values are clamped, non-numeric ignored
+    got = extract_cost_analysis(Fake({"flops": -1.0, "bytes accessed": "x"}))
+    assert got["flops"] == 0.0 and got["bytes_accessed"] == 0.0
+
+
+def test_extract_memory_analysis_real_jit():
+    c = _compiled(lambda x: (x @ x).sum(), jnp.ones((32, 32)))
+    ma = extract_memory_analysis(c)
+    if ma:  # backend exposes it (this jaxlib's CPU does)
+        assert ma["peak_bytes"] == (ma.get("argument_bytes", 0.0)
+                                    + ma.get("output_bytes", 0.0)
+                                    + ma.get("temp_bytes", 0.0)
+                                    - ma.get("alias_bytes", 0.0))
+
+
+def test_cost_index_capture_and_cache():
+    tr = Tracer(ring_size=256)
+    set_tracer(tr)
+    ci = CompiledCostIndex()
+    f = jax.jit(lambda x: (x @ x).sum())
+    x = jnp.ones((16, 16))
+    f(x)  # warm first, so the cache size is stable across observes
+    rec = ci.observe("t/f", f, (x,))
+    assert rec.error is None and rec.flops > 0
+    assert rec.captures == 1
+    # warm path: same cache size -> no re-capture
+    f(x)
+    rec2 = ci.observe("t/f", f, (x,))
+    assert rec2.captures == 1
+    # a perf/compiled instant landed with the registered schema args
+    evs = [e for e in tr.events() if e["name"] == "perf/compiled"]
+    assert len(evs) == 1
+    assert evs[0]["args"]["entry"] == "t/f"
+    assert not validate_events(tr.events(), strict=True)
+
+
+def test_cost_index_recapture_on_recompile():
+    ci = CompiledCostIndex()
+    f = jax.jit(lambda x: (x * 2).sum())
+    a = jnp.ones((8,))
+    f(a)
+    ci.observe("t/g", f, (a,))
+    b = jnp.ones((16,))  # new shape -> jit cache grows
+    f(b)
+    rec = ci.observe("t/g", f, (b,))
+    assert rec.captures == 2
+
+
+def test_cost_index_observe_never_raises():
+    ci = CompiledCostIndex()
+    rec = ci.observe("t/broken", object(), ())  # no .lower at all
+    assert rec.error is not None
+    assert ci.summary()["t/broken"]["error"]
+
+
+def test_cost_index_donated_args_abstractified():
+    """Capture must work from the caller's (possibly donated) arrays."""
+    ci = CompiledCostIndex()
+    f = jax.jit(lambda s, x: (s + x, x.sum()), donate_argnums=(0,))
+    s, x = jnp.ones((8,)), jnp.ones((8,))
+    out, _ = f(s, x)  # s is now deleted
+    rec = ci.observe("t/donate", f, (s, x))
+    assert rec.error is None
+
+
+def test_step_stats_mfu_and_verdict():
+    ci = CompiledCostIndex()
+    f = jax.jit(lambda x: (x @ x).sum())
+    x = jnp.ones((64, 64))
+    ci.observe("t/mm", f, (x,))
+    stats = ci.step_stats("t/mm", wall_s=1.0)
+    assert stats is not None
+    peak = platform_peaks()["peak_tflops"] * 1e12
+    rec = ci.get("t/mm")
+    assert stats["mfu"] == pytest.approx(
+        rec.flops / (peak * ci.local_devices))
+    # a 64^3 matmul over a full second is overwhelmingly overhead; the
+    # verdict names collectives on a multi-device mesh, the host on one
+    expect = "comm-bound" if ci.local_devices > 1 else "host-bound"
+    assert stats["verdict"] == expect
+    assert ci.step_stats("t/mm", wall_s=0.0) is None
+    assert ci.step_stats("t/missing", wall_s=1.0) is None
+
+
+def test_trace_metadata_carries_cost_table(tmp_path):
+    tr = Tracer(ring_size=64)
+    set_tracer(tr)
+    ci = CompiledCostIndex()
+    ci.observe("t/meta", jax.jit(lambda x: x + 1), (jnp.ones((4,)),))
+    path = tr.save(str(tmp_path / "t.json"))
+    with open(path) as f:
+        doc = json.load(f)
+    assert "t/meta" in doc["otherData"]["perf"]
+
+
+# ------------------------------------------------------------------ #
+# memwatch
+# ------------------------------------------------------------------ #
+
+
+def test_memory_stats_cpu_fallback():
+    # CPU backend has no allocator ledger: the normalized readers return
+    # {} and every legacy shim keeps its historical shape
+    assert device_memory_stats() == {}
+    assert aggregate_memory_stats() == {}
+    assert memory_status() == {"bytes_in_use": 0, "peak_bytes_in_use": 0}
+    assert SynchronizedWallClockTimer.memory_usage().startswith("Memory:")
+
+
+def test_memwatch_watermark_lane():
+    tr = Tracer(ring_size=128)
+    set_tracer(tr)
+    mw = MemWatch()
+    with tr.span("engine/forward", lane="engine") as sp:
+        mw.annotate(sp, "forward")
+    evs = tr.events()
+    marks = [e for e in evs if e["name"] == "mem/watermark"]
+    assert len(marks) == 1 and marks[0]["args"]["phase"] == "forward"
+    spans = [e for e in evs if e["name"] == "engine/forward"]
+    assert spans[0]["args"]["hbm_peak"] == 0  # zeros on CPU, key present
+    assert not validate_events(evs, strict=True)
+
+
+def test_memwatch_postmortem_through_flight(tmp_path):
+    fpath = str(tmp_path / "f.bin")
+    fl = flight_mod.FlightRecorder(fpath, capacity=64)
+    tr = Tracer(ring_size=128, flight=fl)
+    set_tracer(tr)
+    x = jnp.ones((32, 32))  # a live buffer the dump must see
+    mw = MemWatch(top_k=4)
+    payload = mw.post_mortem("test oom")
+    assert payload["live_buffers"] >= 1
+    assert any(b["shape"] == "32x32" for b in payload["buffers"])
+    for b in payload["buffers"]:
+        assert set(b) == {"shape", "dtype", "nbytes", "sharding"}
+    fl.flush()
+    # the dump rode the tracer's inline flight sink: recoverable from
+    # disk as a SIGKILLed process would leave it
+    snap = flight_mod.recover(fpath)
+    names = [e["name"] for e in snap.events]
+    assert "mem/postmortem" in names and "mem/buffer" in names
+    buf = next(e for e in snap.events if e["name"] == "mem/buffer")
+    assert buf["args"]["nbytes"] > 0
+    assert mw.postmortems == 1
+    del x
+
+
+def test_memwatch_near_oom_trip(monkeypatch):
+    tr = Tracer(ring_size=64)
+    set_tracer(tr)
+    mw = MemWatch(near_oom_fraction=0.9)
+    fake = {"bytes_in_use": 95, "peak_bytes_in_use": 99, "bytes_limit": 100}
+    monkeypatch.setattr("deeperspeed_tpu.monitor.memwatch."
+                        "aggregate_memory_stats", lambda: fake)
+    mw.sample("step")
+    assert mw.postmortems == 1
+    mw.sample("step")  # still high: disarmed, no second dump
+    assert mw.postmortems == 1
+    fake = {"bytes_in_use": 10, "peak_bytes_in_use": 99, "bytes_limit": 100}
+    monkeypatch.setattr("deeperspeed_tpu.monitor.memwatch."
+                        "aggregate_memory_stats", lambda: fake)
+    mw.sample("step")  # usage fell: re-arms
+    fake = {"bytes_in_use": 95, "peak_bytes_in_use": 99, "bytes_limit": 100}
+    monkeypatch.setattr("deeperspeed_tpu.monitor.memwatch."
+                        "aggregate_memory_stats", lambda: fake)
+    mw.sample("step")
+    assert mw.postmortems == 2
+
+
+def test_memwatch_bad_fraction():
+    with pytest.raises(ValueError):
+        MemWatch(near_oom_fraction=0.0)
+
+
+# ------------------------------------------------------------------ #
+# ledger
+# ------------------------------------------------------------------ #
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_ledger_append_check_round_trip(tmp_path):
+    led = str(tmp_path / "ledger.jsonl")
+    rc = ledger_main(["append", "--root", REPO_ROOT, "--ledger", led])
+    assert rc == 0
+    records = PerfLedger(led).read()
+    assert len(records) >= 10  # the corpus is real
+    for r in records:
+        assert {"metric", "value", "platform", "source", "git_rev",
+                "wall_time", "run"} <= set(r)
+    # same corpus vs itself: clean gate
+    assert ledger_main(["check", "--root", REPO_ROOT, "--ledger", led]) == 0
+
+
+def test_ledger_check_seeds_empty_ledger(tmp_path):
+    led = str(tmp_path / "ledger.jsonl")
+    assert ledger_main(["check", "--root", REPO_ROOT, "--ledger", led]) == 0
+    assert PerfLedger(led).read()  # first run seeded it
+
+
+def test_ledger_seeded_regression_exits_nonzero(tmp_path, capsys):
+    led = str(tmp_path / "ledger.jsonl")
+    assert ledger_main(["append", "--root", REPO_ROOT, "--ledger", led]) == 0
+    # a live record far below the throughput baseline must fail the gate
+    rc = ledger_main(["check", "--root", REPO_ROOT, "--ledger", led,
+                      "--metric", "serving.tokens_per_sec",
+                      "--value", "1.0", "--platform", "cpu"])
+    assert rc == 1
+    assert "serving.tokens_per_sec" in capsys.readouterr().err
+
+
+def test_ledger_degraded_corpus_exits_nonzero(tmp_path):
+    """Full-file path: a degraded BENCH file (not just a --value) fails."""
+    root = tmp_path / "repo"
+    root.mkdir()
+    src = json.load(open(os.path.join(REPO_ROOT, "BENCH_serving.json")))
+    with open(root / "BENCH_serving.json", "w") as f:
+        json.dump(src, f)
+    led = str(root / "PERF_LEDGER.jsonl")
+    assert ledger_main(["append", "--root", str(root), "--ledger", led]) == 0
+    src["decode_compiles"] = 5  # the one-compile invariant broke
+    with open(root / "BENCH_serving.json", "w") as f:
+        json.dump(src, f)
+    assert ledger_main(["check", "--root", str(root), "--ledger", led]) == 1
+
+
+def test_ledger_missing_files_skip_not_fail(tmp_path):
+    root = tmp_path / "empty"
+    root.mkdir()
+    records, notes = collect_current(str(root))
+    assert records == []
+    assert any("missing" in n for n in notes)
+
+
+def test_ledger_baseline_is_rolling_median(tmp_path):
+    led = PerfLedger(str(tmp_path / "l.jsonl"), baseline_n=3)
+    for v in (10.0, 100.0, 11.0, 12.0, 13.0):
+        led.append([{"metric": "m", "value": v, "platform": "cpu",
+                     "source": "t", "git_rev": "x", "wall_time": 0.0,
+                     "run": {}}])
+    # last 3 = [11, 12, 13] -> median 12; the early outlier aged out
+    assert led.baseline("m", "cpu") == 12.0
+    assert led.baseline("m", "tpu") is None  # platform-scoped
+    assert led.baseline("m") == 12.0
+
+
+def test_metric_spec_directions():
+    hi = MetricSpec("m", "f", ("p",), "higher", 0.10)
+    assert not hi.regressed(95.0, 100.0)
+    assert hi.regressed(89.0, 100.0)
+    lo = MetricSpec("m", "f", ("p",), "lower", 0.10)
+    assert not lo.regressed(105.0, 100.0)
+    assert lo.regressed(111.0, 100.0)
+    # zero-tolerance counter: one extra compile is the regression
+    exact = MetricSpec("m", "f", ("p",), "lower", 0.0)
+    assert not exact.regressed(1.0, 1.0)
+    assert exact.regressed(2.0, 1.0)
+
+
+def test_committed_ledger_checks_clean():
+    """The repo ships a seeded PERF_LEDGER.jsonl; the gate over the
+    committed corpus must be green (the acceptance criterion)."""
+    assert os.path.exists(os.path.join(REPO_ROOT, "PERF_LEDGER.jsonl"))
+    assert ledger_main(["check", "--root", REPO_ROOT]) == 0
+
+
+def test_specs_cover_corpus():
+    files = {s.file for s in METRIC_SPECS}
+    for f in ("BENCH_comm.json", "BENCH_serving.json", "BENCH_fleet.json",
+              "BENCH_obs.json", "BENCH_datapipe.json",
+              "BENCH_resilience.json", "BENCH_elastic.json"):
+        assert f in files
+
+
+# ------------------------------------------------------------------ #
+# engine + serving integration (the acceptance criterion)
+# ------------------------------------------------------------------ #
+
+
+def _loss_fn(params, batch):
+    x, y = batch
+    return (((x @ params["w"]) - y) ** 2).mean()
+
+
+def test_engine_train_batch_carries_mfu_and_hbm(tmp_path):
+    trace = str(tmp_path / "t.json")
+    engine, *_ = deepspeed.initialize(
+        model=_loss_fn, model_parameters={"w": jnp.zeros((8, 2))},
+        config_params={
+            "train_batch_size": 8,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "monitor": {"trace_path": trace, "perf": True},
+        })
+    x = np.ones((8, 8), np.float32)
+    y = np.zeros((8, 2), np.float32)
+    for _ in range(3):
+        engine.train_batch((x, y))
+    mon = get_monitor()
+    summary = mon.cost_index.summary()
+    assert summary["engine/train_step"]["flops"] > 0
+    evs = mon.tracer.events()
+    tb = [e for e in evs if e["name"] == "engine/train_batch"]
+    assert tb and {"mfu", "verdict", "hbm_peak"} <= set(tb[-1]["args"])
+    steps = [e for e in evs if e["name"] == "perf/step"]
+    assert steps and steps[-1]["args"]["entry"] == "engine/train_step"
+    # MFU gauge exported
+    assert any("perf_mfu" in line
+               for line in mon.registry.render().splitlines())
+    shutdown_monitor(save=True)
+    assert not __import__("deeperspeed_tpu.monitor.validate",
+                          fromlist=["validate_file"]).validate_file(
+                              trace, strict=True)
+
+
+def test_engine_imperative_path_captures_cost():
+    engine, *_ = deepspeed.initialize(
+        model=_loss_fn, model_parameters={"w": jnp.zeros((8, 2))},
+        config_params={
+            "train_batch_size": 8,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "monitor": {"perf": True},
+        })
+    x = np.ones((8, 8), np.float32)
+    y = np.zeros((8, 2), np.float32)
+    loss = engine.forward((x, y))
+    engine.backward(loss)
+    engine.step()
+    summary = get_monitor().cost_index.summary()
+    assert summary["engine/forward_grad"]["flops"] > 0
+    assert "engine/apply_update" in summary
+
+
+def test_engine_perf_off_no_cost_index():
+    engine, *_ = deepspeed.initialize(
+        model=_loss_fn, model_parameters={"w": jnp.zeros((8, 2))},
+        config_params={
+            "train_batch_size": 8,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "monitor": {"trace_enabled": True},
+        })
+    assert get_monitor().cost_index is None
+    x = np.ones((8, 8), np.float32)
+    y = np.zeros((8, 2), np.float32)
+    engine.train_batch((x, y))  # default path untouched
+    evs = get_monitor().tracer.events()
+    tb = [e for e in evs if e["name"] == "engine/train_batch"]
+    assert "mfu" not in tb[-1].get("args", {})
+
+
+def test_serving_decode_carries_mfu_stays_one_compile():
+    from deeperspeed_tpu.models.gpt import GPTConfig, make_gpt
+    from deeperspeed_tpu.serving import ServingEngine
+    from deeperspeed_tpu.serving.config import ServingConfig
+
+    mon = init_monitor({"perf": True})
+    cfg = GPTConfig(vocab_size=97, n_layer=2, n_head=2, d_model=32,
+                    max_seq=64, remat=False, dtype=jnp.float32,
+                    attn_impl="xla")
+    init_fn, _, _, _ = make_gpt(cfg)
+    params = init_fn(jax.random.PRNGKey(0))
+    scfg = ServingConfig(num_slots=2, block_size=4, num_blocks=32,
+                        max_seq_len=48)
+    eng = ServingEngine(cfg, params, scfg)
+    rid = eng.submit([5, 6, 7, 8], max_new_tokens=3)
+    for _ in range(16):
+        eng.step()
+        if eng.get(rid).state == "finished":
+            break
+    assert eng.get(rid).state == "finished"
+    # cost capture must NOT add decode compiles (AOT lowering is outside
+    # the jit cache) — the one-compile invariant the serving tests and
+    # the ledger's serving.decode_compiles metric both key on
+    assert eng.decode_compile_count == 1
+    summary = mon.cost_index.summary()
+    assert summary["serving/decode_step"]["flops"] > 0
+    assert any(k.startswith("serving/prefill_step[b") for k in summary)
+    evs = mon.tracer.events()
+    dec = [e for e in evs if e["name"] == "serving/decode"]
+    assert {"mfu", "hbm_peak"} <= set(dec[-1]["args"])
+    assert not validate_events(evs, strict=True)
