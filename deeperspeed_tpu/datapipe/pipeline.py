@@ -63,6 +63,14 @@ class DataPipe:
         self.dataset = dataset
         self.cfg = cfg
         self.global_rows = int(global_rows)
+        if cfg.stage_to_device and place_fn is None:
+            # standalone use (no engine supplying its _place_batch):
+            # stage through the shared sharding substrate — batch axes of
+            # the default data mesh
+            from ..sharding import default_mesh, place_batch
+
+            _mesh = default_mesh()
+            place_fn = lambda b: place_batch(_mesh, b)  # noqa: E731
         self.place_fn = place_fn if cfg.stage_to_device else None
         self.collate_fn = collate_fn or stack_collate
         self.packer = (

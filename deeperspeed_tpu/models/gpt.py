@@ -376,10 +376,10 @@ def _shard_act(x, mesh, spec):
         return x
     from jax.sharding import NamedSharding
 
-    from ..parallel.topology import filter_spec
+    from ..sharding.rules import translate_spec
 
     return jax.lax.with_sharding_constraint(
-        x, NamedSharding(mesh, filter_spec(spec, mesh))
+        x, NamedSharding(mesh, translate_spec(spec, mesh))
     )
 
 

@@ -132,6 +132,17 @@ METRIC_SPECS: Tuple[MetricSpec, ...] = (
     # hardware MFU (last real-TPU window)
     MetricSpec("mfu.1p3b.micro_step_floor_tflops", "MFU_DECOMP.json",
                ("1.3b", "micro_step_floor_tflops"), "higher", 0.10),
+    # sharding substrate (PR 13): loss parity across layouts is an
+    # exactness gate; step time per layout is wide-band (CPU-host noise)
+    MetricSpec("mesh.parity.max_loss_delta", "BENCH_mesh.json",
+               ("parity", "max_loss_delta"), "lower", 0.0, 1e-6,
+               note="canonical mesh must reproduce the legacy loss curve"),
+    MetricSpec("mesh.dp_fsdp.step_ms", "BENCH_mesh.json",
+               ("layouts", "dp2_fsdp4", "step_ms"), "lower", 1.00, 5.0),
+    MetricSpec("mesh.zero3.sharded_frac", "BENCH_mesh.json",
+               ("layouts", "fsdp8_zero3", "param_sharded_frac"),
+               "higher", 0.0, 0.01,
+               note="ZeRO-3 on fsdp must actually shard the param bytes"),
 )
 
 _SPECS_BY_NAME = {s.name: s for s in METRIC_SPECS}

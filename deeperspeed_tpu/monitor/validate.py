@@ -73,6 +73,11 @@ EVENT_ARG_SCHEMAS = {
     "mem/watermark": ("phase", "bytes_in_use", "peak_bytes"),
     "mem/postmortem": ("reason", "bytes_in_use", "buffers"),
     "mem/buffer": ("rank", "shape", "dtype", "nbytes", "sharding"),
+    # sharding substrate: every mesh build announces its layout, and the
+    # bench's placement audits record what actually sharded — BENCH_mesh
+    # and post-hoc layout debugging join on these
+    "mesh/build": ("axes", "devices"),
+    "mesh/audit": ("tree", "sharded_frac", "digest"),
 }
 
 # strict-mode name discipline: one prefix per subsystem that emits
@@ -80,7 +85,7 @@ EVENT_ARG_SCHEMAS = {
 KNOWN_EVENT_PREFIXES = (
     "engine/", "pipe/", "offload/", "comm/", "kernels/", "datapipe/",
     "resilience/", "serving/", "flight/", "run/", "goodput/", "trace/",
-    "monitor/", "perf/", "mem/",
+    "monitor/", "perf/", "mem/", "mesh/",
 )
 KNOWN_EVENT_NAMES = frozenset({
     "xla_compile", "recompile!", "process_name", "thread_name",

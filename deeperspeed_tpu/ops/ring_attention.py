@@ -163,17 +163,22 @@ def make_context_parallel_attention(
 ):
     """Wrap ring/ulysses attention in shard_map over `mesh` for GLOBAL
     (B, S, H, Dh) arrays: batch sharded over `batch_axis`, sequence over
-    `seq_axis`, heads over `head_axis` (TP). Returns fn(q, k, v) -> out."""
-    assert strategy in ("ring", "ulysses"), strategy
-    from ..parallel.topology import filter_spec
+    `seq_axis`, heads over `head_axis` (TP). Returns fn(q, k, v) -> out.
 
-    spec = filter_spec(P(batch_axis, seq_axis, head_axis, None), mesh)
-    if tuple(spec)[1] is None:
+    Axis names resolve through the sharding rule table, so the legacy
+    defaults (``data``/``model``/``seq``) bind to a canonical
+    dp×fsdp×tp×sp mesh's ``sp`` axis (and vice versa)."""
+    assert strategy in ("ring", "ulysses"), strategy
+    from ..sharding.rules import translate_spec
+
+    spec = translate_spec(P(batch_axis, seq_axis, head_axis, None), mesh)
+    resolved_seq = tuple(spec)[1]
+    if resolved_seq is None:
         # Refuse rather than silently running dense full-sequence attention:
         # a user who asked for context parallelism must get it (or an error).
         raise ValueError(
-            f"{strategy} attention needs a mesh with a '{seq_axis}' axis of "
-            f"size > 1; got mesh axes {dict(mesh.shape)}"
+            f"{strategy} attention needs a mesh with a '{seq_axis}' (or "
+            f"'sp') axis of size > 1; got mesh axes {dict(mesh.shape)}"
         )
     inner = ring_attention if strategy == "ring" else ulysses_attention
 
@@ -185,6 +190,6 @@ def make_context_parallel_attention(
         **_SHMAP_CHECK_KWARGS,
     )
     def attend(q, k, v):
-        return inner(q, k, v, axis_name=seq_axis, causal=causal)
+        return inner(q, k, v, axis_name=resolved_seq, causal=causal)
 
     return attend

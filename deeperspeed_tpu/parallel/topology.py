@@ -218,7 +218,6 @@ def build_mesh(
     simple reshape on CPU meshes.
     """
     import jax
-    from jax.sharding import Mesh
 
     if devices is None:
         devices = jax.devices()
@@ -249,7 +248,11 @@ def build_mesh(
         )
     except Exception:
         mesh_devices = np.asarray(devices).reshape(shape)
-    return Mesh(mesh_devices, tuple(dims.keys()))
+    # all Mesh objects are constructed through the sharding factory (lazy
+    # import: sharding.mesh.from_config calls back into build_mesh)
+    from ..sharding.mesh import make_mesh
+
+    return make_mesh(mesh_devices, tuple(dims.keys()))
 
 
 def filter_spec(spec, mesh):
@@ -280,7 +283,8 @@ def filter_spec(spec, mesh):
 def single_device_mesh(axis_names=(DATA_AXIS,)):
     """A trivial mesh over one device (useful for tests / single chip)."""
     import jax
-    from jax.sharding import Mesh
+
+    from ..sharding.mesh import make_mesh
 
     dev = np.asarray(jax.devices()[:1]).reshape((1,) * len(axis_names))
-    return Mesh(dev, tuple(axis_names))
+    return make_mesh(dev, tuple(axis_names))

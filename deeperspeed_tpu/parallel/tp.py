@@ -31,7 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .topology import DATA_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS, filter_spec
+from .topology import DATA_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS
 
 
 # ------------------------------------------------------------------ #
@@ -66,11 +66,16 @@ def constrain(x, spec: P, mesh: Optional[Mesh]):
 
     Entries may be axis names, None (force replicated on that dim) or
     ``P.UNCONSTRAINED`` (let the partitioner keep whatever sharding — e.g.
-    the data-parallel batch sharding — it already picked)."""
+    the data-parallel batch sharding — it already picked). Axis names are
+    resolved through the sharding rule table, so the legacy 'model'/'seq'
+    specs emitted by this module place correctly on a canonical
+    dp×fsdp×tp×sp mesh (and vice versa)."""
     if mesh is None:
         return x
+    from ..sharding.rules import translate_spec
+
     return jax.lax.with_sharding_constraint(
-        x, NamedSharding(mesh, filter_spec(spec, mesh))
+        x, NamedSharding(mesh, translate_spec(spec, mesh))
     )
 
 

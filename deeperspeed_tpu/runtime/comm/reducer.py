@@ -123,12 +123,22 @@ class GradReducer:
       ``backward()/step()`` path where per-bucket launches are visible.
     """
 
-    def __init__(self, config: CommConfig, mesh, *, axis_name: str = DATA_AXIS,
+    def __init__(self, config: CommConfig, mesh, *, axis_name=DATA_AXIS,
                  registry=None, canonical: int = 0):
         self.cfg = config
         self.mesh = mesh
-        self.axis = axis_name
-        self.world = int(mesh.shape[axis_name])
+        # axis_name: one mesh axis name or a tuple of them — a canonical
+        # dp×fsdp mesh reduces over BOTH batch axes (the engine passes
+        # sharding.rules.batch_axes(mesh)). Collectives and PartitionSpec
+        # entries both accept the tuple form; world is the product.
+        axes = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+        missing = [a for a in axes if a not in mesh.shape]
+        if missing or not axes:
+            raise ValueError(
+                f"reduction axes {axes} not all in mesh {dict(mesh.shape)}")
+        self.axes = axes
+        self.axis = axes[0] if len(axes) == 1 else axes
+        self.world = int(np.prod([mesh.shape[a] for a in axes]))
         # canonical-slot mode (elastic training): residuals and reduction
         # math are keyed to C fixed slots instead of the world size, so
         # checkpointed state is valid on any device count
@@ -156,6 +166,16 @@ class GradReducer:
     def _resolve_hierarchy(self) -> Optional[int]:
         cfg = self.cfg
         if cfg.hierarchical == "off":
+            return None
+        if len(self.axes) > 1:
+            # axis_index_groups address ranks within ONE named axis; the
+            # two-level schedule therefore only applies to single-axis
+            # (legacy data / pure-dp or pure-fsdp) reductions
+            if cfg.hierarchical == "on":
+                logger.warning(
+                    "comm: hierarchical schedule is single-axis only but "
+                    "the mesh reduces over %s; using the flat schedule",
+                    self.axes)
             return None
         if cfg.hierarchical == "auto" and jax.process_count() <= 1:
             return None
@@ -247,6 +267,7 @@ class GradReducer:
         return {
             "mode": self.cfg.mode,
             "world": self.world,
+            "axes": list(self.axes),
             "block": self.cfg.block,
             "hier_k": self.hier_k or 0,
             "canonical": self.canonical,

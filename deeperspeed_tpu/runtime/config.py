@@ -424,6 +424,33 @@ class TrainingConfig:
             except ValueError as e:
                 raise ConfigError(f'invalid "comm" block: {e}') from e
 
+        # ---- named mesh (SPMD layout) ----
+        # A "mesh" block chooses the layout over the canonical
+        # dp x fsdp x tp x sp axes (sharding/ package). ZeRO, TP, the
+        # comm reducer, and batch placement all resolve against it.
+        # Validated eagerly so a typo'd axis fails at load time.
+        self.mesh_params = pd.get(c.MESH, None)
+        if self.mesh_params is not None and not isinstance(
+                self.mesh_params, dict):
+            raise ConfigError(
+                '"mesh" must be a dict of axis extents like '
+                '{"dp": 2, "fsdp": 4} (or {"enabled": false})'
+            )
+        explicit_mesh = (self.mesh_params or {}).get(c.MESH_ENABLED)
+        self.mesh_enabled = (
+            explicit_mesh if explicit_mesh is not None
+            else self.mesh_params is not None
+        )
+        self._mesh_config = None
+        if self.mesh_enabled:
+            from ..sharding.config import MeshConfig
+
+            try:
+                self._mesh_config = MeshConfig.from_dict(
+                    dict(self.mesh_params, enabled=True))
+            except ValueError as e:
+                raise ConfigError(f'invalid "mesh" block: {e}') from e
+
         # ---- fused Pallas kernels ----
         # A "kernels" block selects the fused elementwise/optimizer/
         # super-tile attention kernels (ops/kernel_config.py): mode
@@ -481,6 +508,11 @@ class TrainingConfig:
         """The "comm" block as a CommConfig (None when absent or
         disabled); validated at parse time like "serving"."""
         return self._comm_config
+
+    def mesh_config(self):
+        """The "mesh" block as a sharding.MeshConfig (None when absent
+        or disabled); validated at parse time like "comm"."""
+        return self._mesh_config
 
     def get_sparse_attention(self, num_heads: int):
         """Build the configured SparsityConfig (reference runtime/config.py:213

@@ -277,3 +277,16 @@ DATAPIPE_ENABLED_DEFAULT = False
 COMM = "comm"
 COMM_ENABLED = "enabled"
 COMM_ENABLED_DEFAULT = False
+
+#############################################
+# Named mesh (sharding/ package): one "mesh" block chooses the SPMD
+# layout over the canonical dp x fsdp x tp x sp axes. ZeRO stages,
+# TP layers, the comm reducer, and engine/serving/datapipe batch
+# placement all resolve against the resulting jax.sharding.Mesh via
+# the sharding.rules logical-axis table. Keys are validated by
+# sharding.config.MeshConfig.from_dict; block presence enables
+# unless {"enabled": false}.
+#############################################
+MESH = "mesh"
+MESH_ENABLED = "enabled"
+MESH_ENABLED_DEFAULT = False

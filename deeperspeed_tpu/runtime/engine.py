@@ -50,7 +50,7 @@ from ..ops.lamb import FusedLamb
 from ..ops.sgd import SGD
 from ..monitor import get_monitor, init_monitor, trace_instant, trace_span
 from ..resilience.manifest import resolve_load_tag
-from ..parallel.topology import DATA_AXIS, build_mesh, single_device_mesh
+from ..parallel.topology import DATA_AXIS  # noqa: F401 — re-exported for callers
 from ..utils.logging import log_dist, logger
 from ..utils.timer import SynchronizedWallClockTimer, ThroughputTimer
 from . import lr_schedules
@@ -59,6 +59,7 @@ from .config import TrainingConfig
 from .dataloader import DeepSpeedDataLoader
 from .fp16.loss_scaler import LossScaleState, create_loss_scaler
 from .zero import partition
+from .. import sharding
 
 FORWARD_MICRO_TIMER = "forward_microstep"
 BACKWARD_MICRO_TIMER = "backward_microstep"
@@ -112,8 +113,16 @@ class Engine(ConfigAccessorsMixin):
         self.loss_fn = model
         self.module = model  # reference-compatible alias
         self.mpu = mpu
-        self.mesh = mesh if mesh is not None else _default_mesh()
-        self.data_parallel_size = int(self.mesh.shape.get(DATA_AXIS, 1))
+        if mesh is None:
+            mesh_cfg = (config.mesh_config()
+                        if hasattr(config, "mesh_config") else None)
+            mesh = (sharding.from_config(mesh_cfg)
+                    if mesh_cfg is not None else _default_mesh())
+        self.mesh = mesh
+        # the batch dim (and the grad mean) spans all batch axes — dp AND
+        # fsdp on a canonical mesh, the legacy data axis otherwise
+        self.batch_axes = sharding.batch_axes(self.mesh)
+        self.data_parallel_size = sharding.data_parallel_size(self.mesh)
         self.rng = rng if rng is not None else jax.random.PRNGKey(0)
         # per-dispatch rng derivation happens INSIDE the jitted step
         # (fold_in(base, ticket)); a host-side jax.random.split per call
@@ -203,6 +212,13 @@ class Engine(ConfigAccessorsMixin):
             rc = self.monitor.run_context
             trace_instant("run/start", lane="run", run_id=rc.run_id or "",
                           role=rc.role, incarnation=rc.incarnation)
+            # the mesh was resolved before the monitor existed (it feeds
+            # world-size derivation), so announce the layout here — this
+            # is the mesh/build event post-hoc layout debugging joins on
+            trace_instant("mesh/build", lane="mesh",
+                          axes={k: int(v)
+                                for k, v in dict(self.mesh.shape).items()},
+                          devices=int(self.mesh.devices.size))
         # fused Pallas kernels: the "kernels" config block selects the
         # fused elementwise/optimizer/super-tile kernels. Applied
         # process-globally (ops/kernel_config.py) because the consumers
@@ -331,27 +347,23 @@ class Engine(ConfigAccessorsMixin):
         self._comm_acc_reduced = None  # per-cycle backward() routing flag
         self._comm_overlap = None      # OverlapScheduler when overlap is on
         if config.comm_config() is not None:
-            reasons = []
-            if self.zero_stage >= 2:
-                reasons.append(
-                    "zero stage >= 2 already reduce-scatters grads via "
-                    "the grad sharding specs")
+            # The reducer places through the mesh's named batch axes, so
+            # ZeRO>=2 and non-data-axis meshes are no longer excluded:
+            # under ZeRO>=2 the reducer's replicated means are immediately
+            # re-constrained to the sharded grad specs (GSPMD slices them
+            # — reduce-scatter semantics preserved), and tp/sp axes simply
+            # aren't part of the reduction tuple. Only offload still owns
+            # the grad path exclusively.
             if getattr(self, "_offload_cfg", None) is not None:
-                reasons.append("optimizer offload owns the grad path")
-            extra = [a for a, s in self.mesh.shape.items()
-                     if a != DATA_AXIS and int(s) > 1]
-            if extra:
-                reasons.append(f"mesh has non-data axes {extra} (the "
-                               "reducer is data-parallel only)")
-            if reasons:
                 logger.warning(
                     "comm block ignored (keeping the monolithic XLA "
-                    "reduction): %s", "; ".join(reasons))
+                    "reduction): optimizer offload owns the grad path")
             else:
                 from .comm.reducer import GradReducer
 
                 self.comm = GradReducer(
                     config.comm_config(), self.mesh,
+                    axis_name=self.batch_axes,
                     registry=(self.monitor.registry
                               if self.monitor is not None else None),
                     canonical=self.canonical_shards)
@@ -592,20 +604,11 @@ class Engine(ConfigAccessorsMixin):
         )
 
     def _place_batch(self, batch):
-        """Shard a host batch over the data axis (leading dim). Multi-host:
-        each process contributes its local slice via
-        jax.make_array_from_process_local_data."""
-        mesh = self.mesh
-        multihost = jax.process_count() > 1
-
-        def leaf(x):
-            x = np.asarray(x)
-            sharding = NamedSharding(mesh, P(DATA_AXIS, *([None] * (x.ndim - 1))))
-            if multihost:
-                return jax.make_array_from_process_local_data(sharding, x)
-            return jax.device_put(x, sharding)
-
-        return jax.tree.map(leaf, batch)
+        """Shard a host batch over the mesh's batch axes (leading dim) —
+        routed through sharding.place_batch, the same staging the serving
+        engine and datapipe use. Multi-host: each process contributes its
+        local slice via jax.make_array_from_process_local_data."""
+        return sharding.place_batch(self.mesh, batch)
 
     # ------------------------------------------------------------------ #
     # jitted computations
@@ -797,12 +800,16 @@ class Engine(ConfigAccessorsMixin):
                     mb_body, (zero_g, jnp.float32(0.0), jnp.int32(0)),
                     batch_g)
                 loss = loss_sum / gas
-            loss = jax.lax.pmean(loss, DATA_AXIS)
+            loss = jax.lax.pmean(loss, self.batch_axes)
             grads = jax.tree.map(
                 lambda g: g.astype(self._grad_dtype)[None], grads)
             return loss, grads
 
-        dspec = P(DATA_AXIS)
+        # one batch-axis entry covering all batch axes (dp+fsdp on a
+        # canonical mesh, data on a legacy one)
+        ax = (self.batch_axes if len(self.batch_axes) > 1
+              else self.batch_axes[0])
+        dspec = P(ax)
         in_specs = (
             jax.tree.map(lambda _: P(), state.params),
             P(),
@@ -838,7 +845,8 @@ class Engine(ConfigAccessorsMixin):
             return jnp.reshape(x, (C, x.shape[0] // C) + x.shape[1:])
 
         batch_c = jax.tree.map(resh, batch)
-        slot_sharding = jax.sharding.NamedSharding(self.mesh, P(DATA_AXIS))
+        slot_sharding = jax.sharding.NamedSharding(
+            self.mesh, sharding.batch_spec(self.mesh, 1))
         batch_c = jax.tree.map(
             lambda x: jax.lax.with_sharding_constraint(x, slot_sharding),
             batch_c)
@@ -2246,12 +2254,9 @@ class Engine(ConfigAccessorsMixin):
 
 
 def _default_mesh():
-    import jax as _jax
-
-    n = len(_jax.devices())
-    if n == 1:
-        return single_device_mesh((DATA_AXIS,))
-    return build_mesh({DATA_AXIS: n})
+    # all devices on the legacy data axis (sharding.default_mesh mirrors
+    # this exactly; kept as one call site so the behavior can't fork)
+    return sharding.default_mesh()
 
 
 def _loss_fn_takes_rng(fn) -> bool:
@@ -2348,6 +2353,13 @@ def initialize(
         config = getattr(args, "deepspeed_config", None)
     assert config is not None, "a config (dict or json path) is required"
 
+    # A "mesh" block in the config chooses the SPMD layout. It must be
+    # built BEFORE TrainingConfig: the batch triple's world_size is
+    # derived FROM the mesh, but the block lives inside the config — so
+    # peek the raw dict here and hand every engine the built mesh.
+    if mesh is None:
+        mesh = _mesh_from_raw_config(config)
+
     from .pipe.module import PipelineModule
 
     # Streaming ZeRO-Infinity route (reference engine.py:803 one-flag
@@ -2361,9 +2373,9 @@ def initialize(
 
     if isinstance(model, (_GPTConfig, _BertConfig)):
         # streaming world = the dp extent (single-controller; one device
-        # unless a mesh with a data axis is given) — NOT jax.device_count,
+        # unless a mesh with batch axes is given) — NOT jax.device_count,
         # which would mis-derive the batch triple on multi-device hosts
-        world_size = (int(mesh.shape.get(DATA_AXIS, 1))
+        world_size = (sharding.data_parallel_size(mesh)
                       if mesh is not None else 1)
         ds_config = (config if isinstance(config, TrainingConfig)
                      else TrainingConfig(config, world_size=world_size))
@@ -2423,6 +2435,29 @@ def initialize(
 
 def _world_size_for_config(mesh) -> int:
     if mesh is not None:
-        return int(mesh.shape.get(DATA_AXIS, 1))
+        return sharding.data_parallel_size(mesh)
     n = len(jax.devices())
     return n
+
+
+def _mesh_from_raw_config(config) -> Optional["jax.sharding.Mesh"]:
+    """Build the mesh a config's ``"mesh"`` block describes (None when
+    the block is absent or disabled). Accepts the same config forms as
+    initialize(): a TrainingConfig, a dict, or a json path."""
+    raw = config
+    if isinstance(raw, TrainingConfig):
+        mc = raw.mesh_config()
+        return sharding.from_config(mc) if mc is not None else None
+    if isinstance(raw, str):
+        import json
+
+        with open(raw) as f:
+            raw = json.load(f)
+    if not isinstance(raw, dict):
+        return None
+    block = raw.get("mesh")
+    if not isinstance(block, dict):
+        return None
+    if block.get("enabled") is False:
+        return None
+    return sharding.from_config(block)

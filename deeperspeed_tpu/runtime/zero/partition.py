@@ -1,4 +1,4 @@
-"""ZeRO stages as sharding policy.
+"""ZeRO stages as sharding policy — thin adapter over ``sharding.rules``.
 
 The reference implements ZeRO imperatively (flattened partitions, backward
 hooks, bucketed reduce — /root/reference/deepspeed/runtime/zero/stage{1,2}.py,
@@ -6,119 +6,108 @@ stage3.py). Under XLA the same memory/communication semantics are expressed
 declaratively as sharding specs on the train-step's inputs/outputs; the
 compiler then schedules and overlaps the collectives:
 
-  stage 0: params, grads, optimizer state replicated over 'data'; grads
-           all-reduced (psum).
-  stage 1: fp32 master + optimizer moments sharded over 'data'; grads
-           all-reduced, each shard updated locally, updated params
-           all-gathered.  (comm == reference stage 1: allreduce + allgather)
+  stage 0: params, grads, optimizer state replicated over the zero axis;
+           grads all-reduced (psum).
+  stage 1: fp32 master + optimizer moments sharded; grads all-reduced,
+           each shard updated locally, updated params all-gathered.
+           (comm == reference stage 1: allreduce + allgather)
   stage 2: grads constrained directly to the master sharding, so XLA emits
            reduce-scatter instead of all-reduce.  (comm == reference stage 2)
-  stage 3: compute-dtype params are ALSO stored sharded over 'data'; XLA
-           inserts all-gathers at use sites (per-layer when the model scans
-           over stacked layers — the analog of stage3's fetch/release hooks).
+  stage 3: compute-dtype params are ALSO stored sharded; XLA inserts
+           all-gathers at use sites (per-layer when the model scans over
+           stacked layers — the analog of stage3's fetch/release hooks).
 
-Per-tensor sharding is structured, not flat: each leaf is sharded along its
-largest axis divisible by the data-axis size (axes already used by tensor
-parallelism are excluded). Leaves with no divisible axis stay replicated —
-for transformers these are biases/layernorms, a negligible fraction.
+The spec derivation now lives in :func:`sharding.rules.zero_tree_specs`,
+generalized over the mesh's *zero axis*: ``fsdp`` on a canonical
+dp×fsdp×tp×sp mesh, the legacy ``data`` axis otherwise — so a
+``{"mesh": {"dp": 2, "fsdp": 4}}`` block turns ZeRO into fsdp-axis
+PartitionSpecs (ZeRO++, arXiv:2306.10209) with no engine change. This
+module keeps the original ``tree_specs`` API so existing callers and
+tests are untouched.
+
+Per-tensor sharding is structured, not flat: each leaf is sharded along
+its largest dim divisible by the zero-axis size (dims already used by
+tensor parallelism are excluded). Leaves with no divisible dim stay
+replicated — for transformers these are biases/layernorms, a negligible
+fraction.
 """
 
 from typing import Optional
 
+from jax.sharding import PartitionSpec as P
+
 import jax
-from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ...parallel.topology import DATA_AXIS, filter_spec
+from ...parallel.topology import DATA_AXIS
+from ...sharding import rules as _rules
+from ...sharding.rules import (add_zero_axis, choose_shard_dim,
+                               named_shardings, zero_tree_specs)
 
-
-def _axis_size(mesh, name) -> int:
-    return mesh.shape.get(name, 1) if mesh is not None else 1
+__all__ = [
+    "choose_zero_axis", "add_data_axis", "param_spec", "master_spec",
+    "grad_spec", "tree_specs", "named_shardings", "constrain",
+]
 
 
 def choose_zero_axis(shape, spec: P, data_size: int) -> Optional[int]:
-    """Pick the dimension to shard over the data axis: the largest dim that is
+    """Pick the dimension to shard over the zero axis: the largest dim
     divisible by data_size and not already sharded by another mesh axis."""
-    best = None
-    best_size = 0
-    for i, d in enumerate(shape):
-        taken = i < len(spec) and spec[i] is not None
-        if taken:
-            continue
-        if d % data_size == 0 and d >= data_size and d > best_size:
-            best, best_size = i, d
-    return best
+    return choose_shard_dim(shape, spec, data_size)
 
 
 def add_data_axis(spec: Optional[P], shape, data_size: int) -> P:
-    """Extend a (possibly empty) TP spec with 'data' sharding on one axis."""
-    spec = spec if spec is not None else P()
-    if data_size <= 1:
-        return spec
-    idx = choose_zero_axis(shape, spec, data_size)
-    if idx is None:
-        return spec
-    parts = list(spec) + [None] * (len(shape) - len(spec))
-    parts[idx] = DATA_AXIS
-    return P(*parts)
+    """Extend a (possibly empty) TP spec with legacy-'data' sharding on
+    one dim (kept for callers that build specs without a mesh)."""
+    return add_zero_axis(spec, shape, DATA_AXIS, data_size)
+
+
+def _leaf(kind, leaf, tp_spec, stage, data_size):
+    base = tp_spec if tp_spec is not None else P()
+    threshold = {"param": 3, "grad": 2, "master": 1}[kind]
+    if stage >= threshold:
+        return add_data_axis(base, leaf.shape, data_size)
+    return base
 
 
 def param_spec(leaf, tp_spec: Optional[P], stage: int, data_size: int) -> P:
     """Sharding spec for the compute-dtype parameter."""
-    base = tp_spec if tp_spec is not None else P()
-    if stage >= 3:
-        return add_data_axis(base, leaf.shape, data_size)
-    return base
+    return _leaf("param", leaf, tp_spec, stage, data_size)
 
 
 def master_spec(leaf, tp_spec: Optional[P], stage: int, data_size: int) -> P:
     """Sharding spec for fp32 master weights and optimizer moments."""
-    base = tp_spec if tp_spec is not None else P()
-    if stage >= 1:
-        return add_data_axis(base, leaf.shape, data_size)
-    return base
+    return _leaf("master", leaf, tp_spec, stage, data_size)
 
 
 def grad_spec(leaf, tp_spec: Optional[P], stage: int, data_size: int) -> P:
     """Sharding spec to constrain gradients to before the optimizer step.
 
-    stage <= 1 -> replicated over data (all-reduce);
+    stage <= 1 -> replicated (all-reduce);
     stage >= 2 -> master sharding (reduce-scatter)."""
-    base = tp_spec if tp_spec is not None else P()
-    if stage >= 2:
-        return add_data_axis(base, leaf.shape, data_size)
-    return base
-
-
+    return _leaf("grad", leaf, tp_spec, stage, data_size)
 
 
 def tree_specs(params, tp_specs, stage: int, mesh, kind: str):
     """Map a params pytree (+ optional tp spec pytree) to a spec pytree.
 
-    kind: 'param' | 'master' | 'grad'
-    """
-    data_size = _axis_size(mesh, DATA_AXIS)
-    fn = {"param": param_spec, "master": master_spec, "grad": grad_spec}[kind]
-    if tp_specs is None:
-        return jax.tree.map(lambda p: fn(p, None, stage, data_size), params)
-    return jax.tree.map(
-        lambda p, s: fn(p, filter_spec(s, mesh), stage, data_size), params, tp_specs
-    )
-
-
-def named_shardings(mesh, specs):
-    return jax.tree.map(lambda s: NamedSharding(mesh, s), specs)
+    kind: 'param' | 'master' | 'grad'. Delegates to
+    ``sharding.rules.zero_tree_specs`` (zero axis = fsdp on canonical
+    meshes, data on legacy ones)."""
+    return zero_tree_specs(params, tp_specs, stage, mesh, kind)
 
 
 def constrain(tree, specs, mesh=None):
-    """with_sharding_constraint over a pytree of PartitionSpecs.
+    """with_sharding_constraint over a pytree of PartitionSpecs (axis
+    names translated onto the mesh's naming generation).
 
-    A mesh is required unless one is already installed via jax.set_mesh."""
+    With ``mesh=None`` the raw specs are applied against the ambient
+    mesh installed via ``jax.set_mesh`` (original behavior)."""
     if mesh is not None:
-        return jax.tree.map(
-            lambda x, s: jax.lax.with_sharding_constraint(x, NamedSharding(mesh, s)),
-            tree,
-            specs,
-        )
+        return _rules.constrain(tree, specs, mesh)
     return jax.tree.map(
-        lambda x, s: jax.lax.with_sharding_constraint(x, s), tree, specs
-    )
+        lambda x, s: jax.lax.with_sharding_constraint(x, s), tree, specs)
+
+
+def zero_axis_name(mesh) -> Optional[str]:
+    """The mesh axis ZeRO shards over (fsdp / data / None)."""
+    return _rules.zero_axis(mesh)

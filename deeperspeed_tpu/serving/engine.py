@@ -343,7 +343,8 @@ class ServingEngine(_ServingBase):
 
     def __init__(self, cfg: GPTConfig, params,
                  serving_config: Union[ServingConfig, dict, None] = None,
-                 clock=time.monotonic, monitor=None, monitor_config=None):
+                 clock=time.monotonic, monitor=None, monitor_config=None,
+                 mesh=None, param_specs=None):
         scfg = (serving_config if isinstance(serving_config, ServingConfig)
                 else ServingConfig.from_dict(serving_config))
         if not cfg.rotary and scfg.max_seq_len > cfg.max_seq:
@@ -352,8 +353,18 @@ class ServingEngine(_ServingBase):
                 f"model's learned-position table ({cfg.max_seq})"
             )
         self.cfg = cfg
+        # dp×tp serving: with a mesh, params place by their TP specs
+        # (sharding rule table translates the model's legacy 'model'
+        # specs onto a canonical tp axis), the paged KV pools shard
+        # their heads dim over tp, and decode inputs shard the slot dim
+        # over the batch axes — all through the one sharding/ module.
+        self.mesh = mesh
+        if mesh is not None:
+            params = self._place_params(params, param_specs)
         self.params = params
         self.kv = PagedKVCache(cfg, scfg)
+        if mesh is not None:
+            self._place_kv_pools()
         super().__init__(scfg, Scheduler(scfg, self.kv.allocator, clock),
                          clock, monitor, monitor_config)
         self._decode_step = make_decode_step(cfg, scfg)
@@ -367,6 +378,62 @@ class ServingEngine(_ServingBase):
             # retraces per length bucket, so it is deliberately unwatched
             self.telemetry.watchdog.watch("serving/decode_step",
                                           self._decode_step)
+
+    # -- mesh placement (dp×tp serving) -------------------------------- #
+
+    def _place_params(self, params, param_specs):
+        from .. import sharding as shd
+
+        if param_specs is None:
+            from ..models.gpt import param_specs as gpt_param_specs
+
+            try:
+                param_specs = gpt_param_specs(self.cfg)
+                jax.tree.flatten(params)  # sanity touch
+                shardings = shd.named_shardings(self.mesh, param_specs)
+                return jax.tree.map(jax.device_put, params, shardings)
+            except Exception:
+                # unknown param structure: replicate rather than refuse
+                logger.warning(
+                    "serving: params do not match the GPT spec tree; "
+                    "replicating them over the mesh")
+                import jax.sharding as js
+
+                rep = js.NamedSharding(self.mesh, js.PartitionSpec())
+                return jax.tree.map(lambda x: jax.device_put(x, rep), params)
+        shardings = shd.named_shardings(self.mesh, param_specs)
+        return jax.tree.map(jax.device_put, params, shardings)
+
+    def _place_kv_pools(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from .. import sharding as shd
+
+        tp = shd.tp_axis(self.mesh)
+        tps = shd.tp_size(self.mesh)
+        n_kv = int(self.kv.k.shape[3])  # (layer, blocks, blk, Hkv, Dh)
+        head_entry = tp if (tps > 1 and n_kv % tps == 0) else None
+        # no trailing None: the decode jit returns pools with the
+        # canonicalized spec, and a trailing-None mismatch would cost a
+        # one-time retrace when the round-tripped pools feed back in
+        sh = NamedSharding(self.mesh, P(None, None, None, head_entry))
+        self.kv.k = jax.device_put(self.kv.k, sh)
+        self.kv.v = jax.device_put(self.kv.v, sh)
+
+    def _place_slot_array(self, x):
+        """Shard a per-slot decode input over the mesh's batch axes (the
+        slot dim is the serving analogue of the batch dim)."""
+        if self.mesh is None:
+            return x
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from .. import sharding as shd
+
+        n = int(x.shape[0])
+        dp = shd.data_parallel_size(self.mesh)
+        spec = (shd.batch_spec(self.mesh, x.ndim)
+                if dp > 1 and n % dp == 0 else P())
+        return jax.device_put(x, NamedSharding(self.mesh, spec))
 
     # compile counters (tests assert decode compiles exactly once)
     @property
@@ -452,10 +519,12 @@ class ServingEngine(_ServingBase):
             _t0 = time.perf_counter()
             timer = self.metrics.timers(DECODE_TIMER)
             timer.safe_start()
-            _dargs = (self.params, self.kv.k, self.kv.v, jnp.asarray(tables),
-                      jnp.asarray(lengths), jnp.asarray(tokens),
-                      jnp.asarray(temps), jnp.asarray(seeds),
-                      jnp.asarray(counts))
+            _place = (self._place_slot_array if self.mesh is not None
+                      else jnp.asarray)
+            _dargs = (self.params, self.kv.k, self.kv.v, _place(tables),
+                      _place(lengths), _place(tokens),
+                      _place(temps), _place(seeds),
+                      _place(counts))
             nxt, self.kv.k, self.kv.v = self._decode_step(*_dargs)
             nxt = np.asarray(nxt)                   # device sync
             timer.stop()

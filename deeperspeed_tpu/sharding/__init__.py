@@ -1,0 +1,31 @@
+"""``sharding/`` — the named-mesh SPMD substrate.
+
+One config block (``"mesh"``) chooses the layout; one rule table maps
+logical tensor dims to mesh axes; ZeRO, TP, SP, the comm reducer, and
+engine/serving/datapipe batch placement all resolve through here. See
+``docs/tutorials/sharding.md``.
+"""
+
+from .audit import audit_tree, spec_digest, tree_digest
+from .config import CANONICAL_AXES, MeshConfig
+from .mesh import (DP_AXIS, FSDP_AXIS, SP_AXIS, TP_AXIS, default_mesh,
+                   describe, from_config, is_canonical, make_mesh)
+from .rules import (DEFAULT_RULES, add_zero_axis, batch_axes, batch_spec,
+                    choose_shard_dim, constrain, data_parallel_size,
+                    logical_constraint, logical_spec, named_shardings,
+                    place_batch, resolve_rules, sp_axis, sp_size,
+                    translate_spec, tp_axis, tp_size, zero_axis, zero_size,
+                    zero_tree_specs)
+
+__all__ = [
+    "MeshConfig", "CANONICAL_AXES",
+    "DP_AXIS", "FSDP_AXIS", "TP_AXIS", "SP_AXIS",
+    "make_mesh", "from_config", "default_mesh", "describe", "is_canonical",
+    "DEFAULT_RULES", "resolve_rules", "translate_spec",
+    "batch_axes", "zero_axis", "tp_axis", "sp_axis",
+    "data_parallel_size", "zero_size", "tp_size", "sp_size",
+    "batch_spec", "place_batch", "constrain", "named_shardings",
+    "logical_spec", "logical_constraint",
+    "zero_tree_specs", "choose_shard_dim", "add_zero_axis",
+    "audit_tree", "spec_digest", "tree_digest",
+]

@@ -1,0 +1,99 @@
+"""The ``"mesh"`` config block — one place where a run chooses its layout.
+
+Validated eagerly at config-parse time (like ``serving``/``comm``/
+``monitor``), so a typo'd axis name fails at load instead of silently
+training replicated. The block maps directly onto the canonical named
+mesh ``dp × fsdp × tp × sp`` built by :mod:`.mesh`:
+
+.. code-block:: json
+
+    {"mesh": {"dp": 2, "fsdp": 4, "tp": 1, "sp": 1}}
+
+* ``dp``    — pure data parallelism: params replicated, batch sharded.
+* ``fsdp``  — the ZeRO axis: batch sharded AND (per ``zero_optimization
+  .stage``) master/grad/param trees sharded over it. ZeRO stages 1/2/3
+  degenerate into fsdp-axis PartitionSpecs (ZeRO++, arXiv:2306.10209).
+* ``tp``    — tensor parallelism (megatron column/row splits).
+* ``sp``    — sequence/context parallelism (ring/Ulysses attention).
+
+Exactly one axis may be ``-1`` (inferred from the device count). A
+``rules`` sub-dict overrides individual logical-axis rules (see
+:data:`..rules.DEFAULT_RULES`), e.g. ``{"rules": {"mlp": null}}`` to keep
+MLP weights replicated on a tp mesh.
+"""
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+__all__ = ["MeshConfig", "CANONICAL_AXES"]
+
+# canonical axis order: batch-ish axes first, the axis with the heaviest
+# steady-state communication (tp, then sp) last so it lands on the
+# innermost ICI ring when the physical topology is folded in
+CANONICAL_AXES: Tuple[str, ...] = ("dp", "fsdp", "tp", "sp")
+
+_VALID_RULE_TARGETS = frozenset(CANONICAL_AXES) | {"expert"}
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Validated ``"mesh"`` block: axis extents + logical-rule overrides."""
+
+    dp: int = -1
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+    rules: Optional[Dict[str, object]] = None
+    enabled: bool = True
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "MeshConfig":
+        d = dict(d or {})
+        enabled = bool(d.pop("enabled", True))
+        rules = d.pop("rules", None)
+        if rules is not None:
+            if not isinstance(rules, dict):
+                raise ValueError(
+                    f'"rules" must be a dict of logical-axis overrides, '
+                    f"got {type(rules).__name__}")
+            for k, v in rules.items():
+                targets = v if isinstance(v, (tuple, list)) else (v,)
+                for t in targets:
+                    if t is not None and t not in _VALID_RULE_TARGETS:
+                        raise ValueError(
+                            f"rules[{k!r}] names unknown mesh axis {t!r} "
+                            f"(valid: {sorted(_VALID_RULE_TARGETS)} or null)")
+            rules = {k: (tuple(v) if isinstance(v, list) else v)
+                     for k, v in rules.items()}
+        unknown = set(d) - set(CANONICAL_AXES)
+        if unknown:
+            raise ValueError(
+                f"unknown mesh keys {sorted(unknown)}; valid keys: "
+                f"{list(CANONICAL_AXES)} + ['rules', 'enabled']")
+        dims = {}
+        for a in CANONICAL_AXES:
+            v = d.get(a, -1 if a == "dp" else 1)
+            if not isinstance(v, int) or isinstance(v, bool):
+                raise ValueError(f'mesh axis "{a}" must be an int, got {v!r}')
+            if v == 0 or v < -1:
+                raise ValueError(
+                    f'mesh axis "{a}" must be a positive extent or -1 '
+                    f"(inferred), got {v}")
+            dims[a] = v
+        inferred = [a for a, v in dims.items() if v == -1]
+        if len(inferred) > 1:
+            raise ValueError(
+                f"at most one mesh axis may be -1 (inferred); got "
+                f"{inferred}")
+        return cls(rules=rules, enabled=enabled, **dims)
+
+    def axis_dims(self) -> Dict[str, int]:
+        """{axis: extent} in canonical order (``-1`` still to be inferred)."""
+        return {a: getattr(self, a) for a in CANONICAL_AXES}
+
+    def as_dict(self) -> dict:
+        out = {a: getattr(self, a) for a in CANONICAL_AXES}
+        if self.rules:
+            out["rules"] = {k: list(v) if isinstance(v, tuple) else v
+                            for k, v in self.rules.items()}
+        return out

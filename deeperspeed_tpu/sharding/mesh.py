@@ -1,0 +1,106 @@
+"""Mesh factory — the one place a ``jax.sharding.Mesh`` is constructed.
+
+Every subsystem (engine, serving, datapipe, comm, TP layers, pipeline
+grid) historically built its own ``Mesh(...)`` ad hoc; this module owns
+construction so they all share one instance and one naming scheme:
+
+* :func:`make_mesh` — the single raw construction site. The legacy
+  builders (``parallel.topology.build_mesh`` / ``single_device_mesh``)
+  now route through it.
+* :func:`from_config` — the ``"mesh"`` config block → a canonical named
+  mesh over ``dp × fsdp × tp × sp`` (size-1 axes kept, so specs are
+  uniform across layouts; :func:`..rules.translate_spec` drops them at
+  constraint time).
+* :func:`default_mesh` — what an engine gets with no mesh and no block:
+  all devices on the legacy ``data`` axis (unchanged behavior).
+
+CPU-testable by construction: under the test harness's
+``xla_force_host_platform_device_count=8`` the same factory code builds
+8-device host meshes, which is how every layout in
+``tests/test_sharding.py`` and ``scripts/mesh_bench.py`` runs without
+hardware.
+"""
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from .config import CANONICAL_AXES, MeshConfig
+
+__all__ = [
+    "DP_AXIS", "FSDP_AXIS", "TP_AXIS", "SP_AXIS", "CANONICAL_AXES",
+    "make_mesh", "from_config", "default_mesh", "describe", "is_canonical",
+]
+
+DP_AXIS = "dp"
+FSDP_AXIS = "fsdp"
+TP_AXIS = "tp"
+SP_AXIS = "sp"
+
+
+def make_mesh(device_array, axis_names):
+    """THE raw Mesh construction site. ``device_array`` must already be
+    shaped to the axis extents (topology-aware ordering is the caller's
+    job — see ``parallel.topology.build_mesh``)."""
+    from jax.sharding import Mesh
+
+    return Mesh(np.asarray(device_array), tuple(axis_names))
+
+
+def from_config(cfg, devices: Optional[Sequence] = None):
+    """``"mesh"`` block (dict or :class:`MeshConfig`) → canonical Mesh.
+
+    Keeps all four named axes, including size-1 ones — a ``{"dp": 8}``
+    mesh is ``dp=8, fsdp=1, tp=1, sp=1``, so the same PartitionSpecs
+    resolve on every layout. Dims of -1 are inferred from the device
+    count (at most one). Emits a ``mesh/build`` trace instant when a
+    monitor is installed, so merged traces record which layout a run
+    actually used.
+    """
+    if not isinstance(cfg, MeshConfig):
+        cfg = MeshConfig.from_dict(cfg)
+    # delegate dim inference + ICI-aware device arrangement to the shared
+    # builder (which constructs through make_mesh above)
+    from ..parallel.topology import build_mesh
+
+    mesh = build_mesh(cfg.axis_dims(), devices=devices)
+    try:  # observability is optional — never a hard dependency
+        from ..monitor import trace_instant
+
+        trace_instant("mesh/build", lane="mesh",
+                      axes=dict(mesh.shape), devices=mesh.devices.size)
+    except Exception:
+        pass
+    return mesh
+
+
+def default_mesh():
+    """All local devices on the legacy ``data`` axis — the engine's
+    behavior when neither a mesh argument nor a ``"mesh"`` block is
+    given. Kept legacy-named so existing data-parallel runs are
+    byte-identical."""
+    import jax
+
+    from ..parallel.topology import DATA_AXIS, build_mesh, single_device_mesh
+
+    n = len(jax.devices())
+    if n == 1:
+        return single_device_mesh((DATA_AXIS,))
+    return build_mesh({DATA_AXIS: n})
+
+
+def is_canonical(mesh) -> bool:
+    """True when the mesh uses the canonical dp/fsdp/tp/sp naming."""
+    return mesh is not None and any(a in mesh.axis_names
+                                    for a in CANONICAL_AXES)
+
+
+def describe(mesh) -> dict:
+    """JSON-able layout descriptor (for BENCH files and trace args)."""
+    if mesh is None:
+        return {"axes": {}, "devices": 0, "generation": "none"}
+    return {
+        "axes": {a: int(s) for a, s in mesh.shape.items()},
+        "devices": int(mesh.devices.size),
+        "generation": "canonical" if is_canonical(mesh) else "legacy",
+    }

@@ -1,0 +1,361 @@
+"""Logical-axis rules: one table mapping *meaning* to mesh axes.
+
+Everything that places an array — ZeRO spec derivation, TP layers, the
+comm reducer, engine/serving/datapipe batch staging, activation
+constraints inside the models — resolves through this module instead of
+hard-coding mesh axis names. Three layers:
+
+1. **The rule table** (:data:`DEFAULT_RULES`): logical tensor dimensions
+   (``batch``, ``seq``, ``embed``, ``heads``, ``mlp``, ``vocab``, ...)
+   → canonical mesh axes (``dp``/``fsdp``/``tp``/``sp``). This is the
+   SNIPPETS-style partition-rule table, with the classic
+   ``"seq": None  # TODO sequence parallel`` cue *implemented*: ``seq``
+   maps to the ``sp`` axis and ring/Ulysses attention consumes it.
+
+2. **Axis aliasing** (:func:`translate_spec`): the repo's existing spec
+   trees name the legacy axes (``data``/``model``/``seq``). Translation
+   maps either naming generation onto whatever axes the mesh actually
+   carries — ``data`` ↔ ``(dp, fsdp)``, ``model`` ↔ ``tp``,
+   ``seq`` ↔ ``sp`` — then drops axes the mesh lacks or carries at
+   size 1 (the old ``filter_spec`` contract). One spec tree therefore
+   places correctly on every layout.
+
+3. **ZeRO as sharding policy** (:func:`zero_tree_specs`): stages 1/2/3
+   are PartitionSpecs over the mesh's *zero axis* — ``fsdp`` on a
+   canonical mesh, ``data`` on a legacy one. ``runtime/zero/partition``
+   is now a thin adapter over this function (same ``tree_specs`` API).
+
+All resolvers accept both mesh generations, so the engine, serving
+stack, and tests migrate incrementally with bit-identical placement on
+legacy meshes.
+"""
+
+from typing import Any, Dict, Optional, Sequence, Tuple, Union
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .mesh import DP_AXIS, FSDP_AXIS, SP_AXIS, TP_AXIS
+
+__all__ = [
+    "DEFAULT_RULES", "resolve_rules", "logical_spec", "logical_constraint",
+    "translate_spec", "batch_axes", "zero_axis", "tp_axis", "sp_axis",
+    "data_parallel_size", "zero_size", "tp_size", "sp_size",
+    "batch_spec", "place_batch", "constrain", "named_shardings",
+    "zero_tree_specs", "choose_shard_dim", "add_zero_axis",
+]
+
+# ---------------------------------------------------------------------- #
+# 1. the logical-axis rule table (SNIPPETS.md [3] style)
+# ---------------------------------------------------------------------- #
+
+# logical dim -> canonical mesh axis (None = replicated). The batch dim
+# spans BOTH data-parallel axes: dp replicates params, fsdp additionally
+# shards them (ZeRO), but each contributes a factor of batch parallelism.
+DEFAULT_RULES: Dict[str, Union[None, str, Tuple[str, ...]]] = {
+    "batch": (DP_AXIS, FSDP_AXIS),
+    "seq": SP_AXIS,        # sequence parallel — the implemented TODO
+    "embed": None,         # residual stream stays replicated
+    "heads": TP_AXIS,
+    "kv": None,
+    "joined_kv": TP_AXIS,
+    "mlp": TP_AXIS,
+    "vocab": TP_AXIS,      # embedding DIM split (vocab-row split is an
+                           # anti-layout on TPU — see tp.vocab_parallel_spec)
+    "layers": None,        # scan-stacked layer axis
+    "expert": "expert",
+}
+
+# legacy mesh axis name -> canonical candidates (and the reverse); used
+# by translate_spec so one spec tree works on both naming generations
+_LEGACY_TO_CANONICAL: Dict[str, Tuple[str, ...]] = {
+    "data": (DP_AXIS, FSDP_AXIS),
+    "model": (TP_AXIS,),
+    "seq": (SP_AXIS,),
+}
+_CANONICAL_TO_LEGACY: Dict[str, Tuple[str, ...]] = {
+    DP_AXIS: ("data",),
+    FSDP_AXIS: ("data",),
+    TP_AXIS: ("model",),
+    SP_AXIS: ("seq",),
+}
+
+
+def resolve_rules(overrides: Optional[Dict] = None) -> Dict:
+    """The rule table with per-run overrides (the mesh block's ``rules``
+    sub-dict) applied."""
+    if not overrides:
+        return dict(DEFAULT_RULES)
+    out = dict(DEFAULT_RULES)
+    out.update(overrides)
+    return out
+
+
+# ---------------------------------------------------------------------- #
+# 2. axis aliasing / spec translation
+# ---------------------------------------------------------------------- #
+
+
+def _expand_name(name: str, mesh) -> Tuple[str, ...]:
+    """One spec axis name -> the axes this mesh carries for it."""
+    if name in mesh.shape:
+        return (name,)
+    for table in (_LEGACY_TO_CANONICAL, _CANONICAL_TO_LEGACY):
+        if name in table:
+            return tuple(a for a in table[name] if a in mesh.shape)
+    return ()
+
+
+def translate_spec(spec, mesh):
+    """Map a PartitionSpec onto whatever axes ``mesh`` carries.
+
+    Superset of ``parallel.topology.filter_spec``: entries are first
+    alias-translated across naming generations (``data`` ↔ dp/fsdp,
+    ``model`` ↔ tp, ``seq`` ↔ sp), then axes the mesh lacks — or carries
+    at size 1 — are dropped. ``None`` and ``P.UNCONSTRAINED`` pass
+    through. On a spec already named in the mesh's own generation this
+    is exactly filter_spec.
+    """
+    if spec is None or mesh is None:
+        return spec
+
+    def keep(a):
+        return mesh.shape.get(a, 0) > 1
+
+    parts = []
+    used = set()  # a mesh axis may appear on at most one dim: when two
+    # canonical axes collapse onto one legacy axis (dp+fsdp -> data),
+    # the first dim keeps it
+    for entry in tuple(spec):
+        if entry is None or entry is P.UNCONSTRAINED:
+            parts.append(entry)
+            continue
+        names = entry if isinstance(entry, tuple) else (entry,)
+        kept = []
+        for n in names:
+            for a in _expand_name(n, mesh):
+                if keep(a) and a not in used:
+                    kept.append(a)
+                    used.add(a)
+        parts.append(tuple(kept) if len(kept) > 1
+                     else (kept[0] if kept else None))
+    return P(*parts)
+
+
+# ---------------------------------------------------------------------- #
+# per-mesh axis resolvers
+# ---------------------------------------------------------------------- #
+
+
+def batch_axes(mesh) -> Tuple[str, ...]:
+    """Axes the batch dimension shards over (grad reduction runs over
+    these): ``(dp, fsdp)`` on a canonical mesh, ``(data,)`` on a legacy
+    one. Axes are returned even at size 1 — NamedSharding tolerates
+    them, and keeping them makes placement uniform across layouts."""
+    if mesh is None:
+        return ()
+    if DP_AXIS in mesh.shape or FSDP_AXIS in mesh.shape:
+        return tuple(a for a in (DP_AXIS, FSDP_AXIS) if a in mesh.shape)
+    return ("data",) if "data" in mesh.shape else ()
+
+
+def zero_axis(mesh) -> Optional[str]:
+    """The axis ZeRO shards params/grads/optimizer state over: ``fsdp``
+    on a canonical mesh (dp replicates — that is the dp/fsdp split),
+    ``data`` on a legacy one."""
+    if mesh is None:
+        return None
+    if FSDP_AXIS in mesh.shape:
+        return FSDP_AXIS
+    if DP_AXIS in mesh.shape:
+        return None  # canonical mesh with no fsdp axis: ZeRO sharding off
+    return "data" if "data" in mesh.shape else None
+
+
+def tp_axis(mesh) -> Optional[str]:
+    if mesh is None:
+        return None
+    if TP_AXIS in mesh.shape:
+        return TP_AXIS
+    return "model" if "model" in mesh.shape else None
+
+
+def sp_axis(mesh) -> Optional[str]:
+    if mesh is None:
+        return None
+    if SP_AXIS in mesh.shape:
+        return SP_AXIS
+    return "seq" if "seq" in mesh.shape else None
+
+
+def _size(mesh, axis: Optional[str]) -> int:
+    return int(mesh.shape[axis]) if (mesh is not None and axis is not None
+                                     and axis in mesh.shape) else 1
+
+
+def data_parallel_size(mesh) -> int:
+    """Product of the batch-axis extents (what the batch triple and the
+    grad mean divide by)."""
+    return int(np.prod([_size(mesh, a) for a in batch_axes(mesh)],
+                       dtype=np.int64)) if mesh is not None else 1
+
+
+def zero_size(mesh) -> int:
+    return _size(mesh, zero_axis(mesh))
+
+
+def tp_size(mesh) -> int:
+    return _size(mesh, tp_axis(mesh))
+
+
+def sp_size(mesh) -> int:
+    return _size(mesh, sp_axis(mesh))
+
+
+# ---------------------------------------------------------------------- #
+# logical specs / constraints
+# ---------------------------------------------------------------------- #
+
+
+def logical_spec(logical_dims: Sequence[Optional[str]], mesh=None,
+                 rules: Optional[Dict] = None) -> P:
+    """``("batch", "seq", "embed")`` → a PartitionSpec.
+
+    Each entry is a logical dim name from the rule table (or ``None`` /
+    ``P.UNCONSTRAINED``, passed through). Without a mesh the spec names
+    canonical axes; with one it is translated onto the axes the mesh
+    carries. Unknown logical names raise — placement typos should fail
+    loudly."""
+    table = resolve_rules(rules)
+    parts = []
+    for name in logical_dims:
+        if name is None or name is P.UNCONSTRAINED:
+            parts.append(name)
+            continue
+        if name not in table:
+            raise ValueError(
+                f"unknown logical axis {name!r}; known: {sorted(table)}")
+        parts.append(table[name])
+    spec = P(*parts)
+    return translate_spec(spec, mesh) if mesh is not None else spec
+
+
+def logical_constraint(x, logical_dims: Sequence[Optional[str]], mesh,
+                       rules: Optional[Dict] = None):
+    """with_sharding_constraint by logical dim names."""
+    if mesh is None:
+        return x
+    spec = logical_spec(logical_dims, mesh, rules)
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def constrain(tree, specs, mesh):
+    """with_sharding_constraint over a pytree of PartitionSpecs, with
+    axis translation (both naming generations accepted)."""
+    if mesh is None:
+        return tree
+    return jax.tree.map(
+        lambda x, s: jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, translate_spec(s, mesh))),
+        tree, specs)
+
+
+def named_shardings(mesh, specs):
+    """Spec pytree -> NamedSharding pytree (translated onto the mesh)."""
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, translate_spec(s, mesh)), specs)
+
+
+# ---------------------------------------------------------------------- #
+# batch placement (engine / serving / datapipe all stage through this)
+# ---------------------------------------------------------------------- #
+
+
+def batch_spec(mesh, ndim: int) -> P:
+    """Leading-dim batch sharding spec for an ndim-D host array."""
+    axes = batch_axes(mesh)
+    lead = axes if len(axes) > 1 else (axes[0] if axes else None)
+    return P(lead, *([None] * (max(ndim, 1) - 1)))
+
+
+def place_batch(mesh, batch):
+    """Shard a host batch pytree over the mesh's batch axes (leading
+    dim). Multi-host: each process contributes its local slice via
+    ``jax.make_array_from_process_local_data``. Scalars replicate."""
+    multihost = jax.process_count() > 1
+
+    def leaf(x):
+        x = np.asarray(x)
+        sh = NamedSharding(mesh, batch_spec(mesh, x.ndim) if x.ndim
+                           else P())
+        if multihost:
+            return jax.make_array_from_process_local_data(sh, x)
+        return jax.device_put(x, sh)
+
+    return jax.tree.map(leaf, batch)
+
+
+# ---------------------------------------------------------------------- #
+# 3. ZeRO stages as zero-axis PartitionSpecs
+# ---------------------------------------------------------------------- #
+
+
+def choose_shard_dim(shape, spec: P, size: int) -> Optional[int]:
+    """Pick the dim to shard over the zero axis: the largest dim
+    divisible by ``size`` and not already sharded by another axis."""
+    best = None
+    best_size = 0
+    for i, d in enumerate(shape):
+        taken = i < len(spec) and spec[i] is not None
+        if taken:
+            continue
+        if d % size == 0 and d >= size and d > best_size:
+            best, best_size = i, d
+    return best
+
+
+def add_zero_axis(spec: Optional[P], shape, axis: Optional[str],
+                  size: int) -> P:
+    """Extend a (possibly empty) TP spec with zero-axis sharding on one
+    structured dim. Leaves with no divisible free dim stay replicated
+    (biases/layernorms — a negligible fraction)."""
+    spec = spec if spec is not None else P()
+    if size <= 1 or axis is None:
+        return spec
+    idx = choose_shard_dim(shape, spec, size)
+    if idx is None:
+        return spec
+    parts = list(spec) + [None] * (len(shape) - len(spec))
+    parts[idx] = axis
+    return P(*parts)
+
+
+def _zero_leaf_spec(leaf, tp_spec: Optional[P], stage: int, kind: str,
+                    axis: Optional[str], size: int) -> P:
+    base = tp_spec if tp_spec is not None else P()
+    threshold = {"param": 3, "grad": 2, "master": 1}[kind]
+    if stage >= threshold:
+        return add_zero_axis(base, leaf.shape, axis, size)
+    return base
+
+
+def zero_tree_specs(params, tp_specs, stage: int, mesh, kind: str):
+    """Map a params pytree (+ optional TP spec pytree) to ZeRO sharding
+    specs over the mesh's zero axis.
+
+    kind: ``'param'`` (sharded from stage 3), ``'grad'`` (stage 2 —
+    reduce-scatter), ``'master'`` (stage 1 — sharded optimizer state).
+    The reference's imperative stages degenerate into these specs under
+    GSPMD; XLA emits the corresponding collectives.
+    """
+    axis = zero_axis(mesh)
+    size = zero_size(mesh)
+    if tp_specs is None:
+        return jax.tree.map(
+            lambda p: _zero_leaf_spec(p, None, stage, kind, axis, size),
+            params)
+    return jax.tree.map(
+        lambda p, s: _zero_leaf_spec(p, translate_spec(s, mesh), stage,
+                                     kind, axis, size),
+        params, tp_specs)

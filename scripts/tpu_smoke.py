@@ -394,6 +394,55 @@ def main():
           f"(mfu {stats['mfu']:.3f}, {stats['tflops']:.1f} TF, "
           f"{stats['verdict']})")
 
+    # ---- sharding substrate: canonical mesh on real chips --------------- #
+    # the CPU suite proves placement semantics on virtual devices; this
+    # proves the "mesh" block trains on the real topology (build_mesh's
+    # ICI-aware device arrangement only matters here) and that ZeRO
+    # shards genuinely land distributed — param_sharded_frac from live
+    # device buffers, not specs
+    if jax.device_count() > 1 and jax.device_count() % 2 == 0:
+        import deeperspeed_tpu as deepspeed
+        from deeperspeed_tpu.sharding import audit_tree, describe
+
+        world = jax.device_count()
+
+        def mesh_loss(p, b):
+            xx, yy = b
+            return jnp.mean((jnp.tanh(xx @ p["w1"]) @ p["w2"] - yy) ** 2)
+
+        mesh_params = {
+            "w1": jnp.zeros((64, 128), jnp.float32),
+            "w2": jnp.zeros((128, 32), jnp.float32),
+        }
+        cfg = {
+            "train_micro_batch_size_per_gpu": 2,
+            "train_batch_size": 2 * world,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+            "zero_optimization": {"stage": 3},
+            "mesh": {"dp": 2, "fsdp": -1},
+        }
+        engine, _, _, _ = deepspeed.initialize(
+            model=mesh_loss, model_parameters=mesh_params,
+            config_params=cfg)
+        rng = np.random.default_rng(1)
+
+        def mesh_step():
+            b = (jnp.asarray(rng.normal(size=(2 * world, 64)),
+                             dtype=jnp.float32),
+                 jnp.asarray(rng.normal(size=(2 * world, 32)),
+                             dtype=jnp.float32))
+            return engine.train_batch(b)
+
+        _check(f"mesh block zero3 train_batch ({describe(engine.mesh)})",
+               mesh_step)
+        if world >= 4:  # fsdp extent > 1: params must actually shard
+            aud = audit_tree(engine.state.params, mesh=engine.mesh)
+            assert aud["sharded_frac"] > 0.5, aud
+            print(f"  {'mesh zero3 placement audit':44s} OK  "
+                  f"(sharded_frac {aud['sharded_frac']:.3f})")
+    else:
+        print("  mesh substrate skipped: needs an even multi-device host")
+
     print("ALL KERNELS OK on hardware")
     return 0
 

@@ -100,9 +100,12 @@ def test_comm_block_invalid_raises_config_error():
         make_engine("int8")  # must be a dict
 
 
-def test_comm_block_ignored_under_zero2():
+def test_comm_block_active_under_zero2():
+    # the sharding substrate removed the ZeRO>=2 exclusion: the reducer
+    # emits replicated means and the engine re-constrains them to the
+    # stage-2 grad specs (loss parity covered in test_sharding.py)
     e = make_engine({"mode": "int8"}, zero_stage=2)
-    assert e.comm is None  # warned + fell back to the XLA reduction
+    assert e.comm is not None and e.comm.cfg.mode == "int8"
 
 
 # --------------------------------------------------------------------- #
