@@ -1,0 +1,79 @@
+"""Static analysis for DeeperSpeed-TPU: a compiled-program auditor
+(donation aliasing, fp64/weak promotions, collective axes, ZeRO-3
+gather leaks, host callbacks — all read from donation-safe AOT
+lowerings) plus an AST repo-rule linter (mesh construction sites, host
+syncs in traced code, PRNGKey hygiene, trace-event-name registry
+cross-check, undeclared config keys).
+
+CLI: ``python -m deeperspeed_tpu.analysis`` — see ``__main__.py`` and
+``docs/tutorials/analysis.md``.
+"""
+
+from .findings import (
+    DEFAULT_BASELINE_FILE,
+    DEFAULT_SUPPRESSIONS_FILE,
+    Finding,
+    Suppression,
+    SuppressionError,
+    apply_suppressions,
+    counts,
+    format_text,
+    load_suppressions,
+    report,
+)
+from .astlint import (
+    RULES,
+    ConfigKeyUndeclaredRule,
+    HostSyncInJitRule,
+    MeshConstructionRule,
+    Module,
+    PRNGKeyInTracedRule,
+    Rule,
+    TraceEventNamesRule,
+    collect_modules,
+    lint_paths,
+    traced_function_defs,
+)
+from .hlo import (
+    ProgramSpec,
+    all_gather_result_bytes,
+    audit_program,
+    audit_programs,
+    collect_collectives,
+    count_alias_pairs,
+    known_rule_axes,
+)
+from .programs import audit_default_programs, default_program_suite
+
+__all__ = [
+    "DEFAULT_BASELINE_FILE",
+    "DEFAULT_SUPPRESSIONS_FILE",
+    "Finding",
+    "Suppression",
+    "SuppressionError",
+    "apply_suppressions",
+    "counts",
+    "format_text",
+    "load_suppressions",
+    "report",
+    "RULES",
+    "ConfigKeyUndeclaredRule",
+    "HostSyncInJitRule",
+    "MeshConstructionRule",
+    "Module",
+    "PRNGKeyInTracedRule",
+    "Rule",
+    "TraceEventNamesRule",
+    "collect_modules",
+    "lint_paths",
+    "traced_function_defs",
+    "ProgramSpec",
+    "all_gather_result_bytes",
+    "audit_program",
+    "audit_programs",
+    "collect_collectives",
+    "count_alias_pairs",
+    "known_rule_axes",
+    "audit_default_programs",
+    "default_program_suite",
+]

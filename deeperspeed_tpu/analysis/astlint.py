@@ -1,0 +1,486 @@
+"""Level-2 repo-rule linter: AST checks for conventions that otherwise
+live only in reviewers' heads.
+
+Rules are pluggable: subclass :class:`Rule` and append to
+:data:`RULES` (or pass your own list to :func:`lint_paths`). Two rule
+shapes exist — per-module rules see one parsed file at a time, and
+repo-level rules see the whole batch at once (needed for cross-file
+checks like the trace-event-name registry diff).
+
+The linter deliberately works on the AST, not regexes: calls split
+across lines, aliased imports, and docstring mentions are all handled
+correctly (a ``trace_span("fwd")`` inside a docstring is not an
+emission).
+
+Shipped rules:
+
+``mesh-construction``
+    ``Mesh(...)`` may only be constructed in ``sharding/mesh.py``
+    (``make_mesh`` is the single raw-construction site; everything
+    else routes through it so layout announcements and validation
+    cannot be skipped).
+``host-sync-in-jit``
+    ``.item()`` / ``jax.device_get`` / ``jax.block_until_ready``
+    inside a traced function — a host sync burned into the compiled
+    program (or a tracer leak at trace time).
+``prngkey-in-traced``
+    fresh ``PRNGKey(...)`` inside a traced step function: the key is
+    baked into the compiled program, so every step reuses the same
+    randomness (nondeterminism bugs of the worst kind — silent).
+``trace-event-names``
+    every event name emitted in source must satisfy
+    ``monitor/validate.py``'s strict-mode registry, and every
+    registered exact name / arg schema must be emitted somewhere —
+    the cross-check holds in both directions.
+``config-key-undeclared``
+    config modules (``**/config.py``) must read keys through declared
+    constants (``runtime/constants.py`` etc.), not inline string
+    literals — an undeclared key is invisible to schema validation.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .findings import Finding
+
+# ---------------------------------------------------------------------------
+# parsing + shared helpers
+
+
+class Module:
+    """One parsed source file."""
+
+    def __init__(self, path: str, relpath: str, tree: ast.AST):
+        self.path = path
+        self.relpath = relpath.replace(os.sep, "/")
+        self.tree = tree
+
+    @classmethod
+    def parse(cls, path: str, root: str) -> Optional["Module"]:
+        try:
+            with open(path, encoding="utf-8") as fh:
+                src = fh.read()
+            tree = ast.parse(src, filename=path)
+        except (SyntaxError, UnicodeDecodeError, OSError):
+            return None
+        return cls(path, os.path.relpath(path, root), tree)
+
+
+def _terminal_name(node: ast.AST) -> Optional[str]:
+    """foo -> 'foo'; a.b.foo -> 'foo'; anything else -> None."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+def _dotted(node: ast.AST) -> str:
+    """Best-effort dotted name for error messages."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+# transforms whose function argument ends up traced by JAX
+_TRACING_TRANSFORMS = {
+    "jit", "pjit", "shard_map", "grad", "value_and_grad", "checkpoint",
+    "remat", "vmap", "pmap", "scan", "custom_vjp", "custom_jvp",
+}
+
+
+def traced_function_defs(tree: ast.AST) -> List[ast.FunctionDef]:
+    """Every FunctionDef in the module that JAX will trace.
+
+    Detected two ways: decorated with a tracing transform (including
+    ``@partial(jax.jit, ...)``), or referenced by name as the function
+    argument of a tracing-transform call anywhere in the module
+    (``self._fn = jax.jit(self._step_body, ...)`` marks a method named
+    ``_step_body``).
+    """
+    defs: List[ast.FunctionDef] = []
+    jitted_names: Set[str] = set()
+
+    def _transform_call(call: ast.Call) -> bool:
+        return _terminal_name(call.func) in _TRACING_TRANSFORMS
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _transform_call(node):
+            for arg in node.args[:1]:  # the function argument is first
+                name = _terminal_name(arg)
+                if name:
+                    jitted_names.add(name)
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        traced = node.name in jitted_names
+        for dec in node.decorator_list:
+            if _terminal_name(dec) in _TRACING_TRANSFORMS:
+                traced = True
+            elif isinstance(dec, ast.Call):
+                if _terminal_name(dec.func) in _TRACING_TRANSFORMS:
+                    traced = True
+                elif (_terminal_name(dec.func) == "partial" and dec.args
+                      and _terminal_name(dec.args[0]) in _TRACING_TRANSFORMS):
+                    traced = True
+        if traced:
+            defs.append(node)
+    return defs
+
+
+def _walk_body(fn: ast.FunctionDef) -> Iterable[ast.AST]:
+    """Walk a traced function's body WITHOUT descending into nested
+    defs that are themselves host-side helpers is over-engineering —
+    nested defs inside a traced fn are traced too, so plain walk."""
+    for stmt in fn.body:
+        yield from ast.walk(stmt)
+
+
+# ---------------------------------------------------------------------------
+# rule plumbing
+
+
+class Rule:
+    name: str = "?"
+    severity: str = "error"
+
+    def check_module(self, mod: Module) -> List[Finding]:
+        return []
+
+    def check_repo(self, mods: Sequence[Module]) -> List[Finding]:
+        return []
+
+    def _finding(self, mod: Module, node: ast.AST, message: str,
+                 severity: Optional[str] = None, **detail) -> Finding:
+        return Finding(rule=self.name, severity=severity or self.severity,
+                       path=mod.relpath, line=getattr(node, "lineno", 0),
+                       message=message, detail=detail or None)
+
+
+class MeshConstructionRule(Rule):
+    """Mesh(...) anywhere but sharding/mesh.py."""
+
+    name = "mesh-construction"
+    severity = "error"
+    allowed = ("sharding/mesh.py",)
+
+    def check_module(self, mod: Module) -> List[Finding]:
+        if mod.relpath.endswith(self.allowed):
+            return []
+        out = []
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) and _terminal_name(node.func) == "Mesh":
+                out.append(self._finding(
+                    mod, node,
+                    f"raw {_dotted(node.func)}(...) construction — route "
+                    "through sharding.mesh.make_mesh so layout validation "
+                    "and the mesh/build announcement cannot be skipped"))
+        return out
+
+
+class HostSyncInJitRule(Rule):
+    """.item() / device_get / block_until_ready inside traced functions."""
+
+    name = "host-sync-in-jit"
+    severity = "error"
+    _sync_names = {"item", "block_until_ready", "device_get"}
+
+    def check_module(self, mod: Module) -> List[Finding]:
+        out = []
+        for fn in traced_function_defs(mod.tree):
+            for node in _walk_body(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = _terminal_name(node.func)
+                if name in self._sync_names:
+                    out.append(self._finding(
+                        mod, node,
+                        f"host sync `{_dotted(node.func)}(...)` inside "
+                        f"traced function `{fn.name}` — either burned into "
+                        "the compiled program or a trace-time crash"))
+        return out
+
+
+class PRNGKeyInTracedRule(Rule):
+    """fresh PRNGKey(...) inside a traced step function."""
+
+    name = "prngkey-in-traced"
+    severity = "error"
+    _key_ctors = {"PRNGKey", "key"}
+
+    def check_module(self, mod: Module) -> List[Finding]:
+        out = []
+        for fn in traced_function_defs(mod.tree):
+            for node in _walk_body(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = _terminal_name(node.func)
+                if name == "PRNGKey" or (
+                        name == "key"
+                        and isinstance(node.func, ast.Attribute)
+                        and _dotted(node.func).endswith("random.key")):
+                    out.append(self._finding(
+                        mod, node,
+                        f"fresh `{_dotted(node.func)}(...)` inside traced "
+                        f"function `{fn.name}` — the key constant-folds "
+                        "into the program, so every step reuses the same "
+                        "randomness; thread keys in as arguments"))
+        return out
+
+
+class TraceEventNamesRule(Rule):
+    """Two-directional diff between emitted event names and the strict
+    registry in monitor/validate.py."""
+
+    name = "trace-event-names"
+    severity = "error"
+
+    # call shapes that emit an event: the module-level tracer helpers
+    # plus Tracer's span/instant methods. Deliberately NOT bare
+    # `counter`/`gauge` — those are the metrics registry (prometheus
+    # names), a different namespace from trace events.
+    _emitters = {"trace_span", "trace_instant", "trace_counter",
+                 "span", "instant"}
+
+    def __init__(self, schemas=None, prefixes=None, names=None):
+        if schemas is None:
+            from ..monitor import validate as _v
+            schemas = _v.EVENT_ARG_SCHEMAS
+            prefixes = _v.KNOWN_EVENT_PREFIXES
+            names = _v.KNOWN_EVENT_NAMES
+        self.schemas = dict(schemas)
+        self.prefixes = tuple(prefixes or ())
+        self.names = frozenset(names or ())
+
+    # -- collection ---------------------------------------------------
+    def _static_name(self, node: ast.AST) -> Tuple[Optional[str], bool]:
+        """(name, is_exact). For f-strings, the static leading text
+        with is_exact=False."""
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value, True
+        if isinstance(node, ast.JoinedStr):
+            head = []
+            for part in node.values:
+                if isinstance(part, ast.Constant) and isinstance(part.value, str):
+                    head.append(part.value)
+                else:
+                    break
+            return ("".join(head) or None), False
+        return None, False
+
+    def _emitted(self, mods: Sequence[Module]):
+        """[(name, exact, mod, node)] for every event emission site."""
+        out = []
+        for mod in mods:
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Call):
+                    if _terminal_name(node.func) in self._emitters and node.args:
+                        name, exact = self._static_name(node.args[0])
+                        if name is not None:
+                            out.append((name, exact, mod, node))
+                    for kw in node.keywords:
+                        if kw.arg == "name":
+                            name, exact = self._static_name(kw.value)
+                            if name is not None and self._looks_like_event(name):
+                                out.append((name, exact, mod, node))
+                elif isinstance(node, ast.Dict):
+                    for k, v in zip(node.keys, node.values):
+                        if (isinstance(k, ast.Constant) and k.value == "name"):
+                            name, exact = self._static_name(v)
+                            if name is not None and self._looks_like_event(name):
+                                out.append((name, exact, mod, node))
+        return out
+
+    def _looks_like_event(self, name: str) -> bool:
+        # dict-literal / name= collection is scoped to strings that are
+        # plausibly event names, so cfg name="adam" style kwargs don't
+        # drown the check
+        return (name in self.names or name in self.schemas
+                or name.startswith(self.prefixes))
+
+    def _known(self, name: str, exact: bool) -> bool:
+        if exact:
+            return name in self.names or name.startswith(self.prefixes)
+        # dynamic name: judge the static prefix if it reaches a
+        # subsystem slash, else give it the benefit of the doubt
+        if name.startswith(self.prefixes):
+            return True
+        return "/" not in name
+
+    # -- the check ----------------------------------------------------
+    def check_repo(self, mods: Sequence[Module]) -> List[Finding]:
+        out: List[Finding] = []
+        emitted = self._emitted(mods)
+        for name, exact, mod, node in emitted:
+            if not self._known(name, exact):
+                out.append(self._finding(
+                    mod, node,
+                    f"event name {name!r} is not registered in "
+                    "monitor/validate.py strict schemas (add it to "
+                    "KNOWN_EVENT_PREFIXES / KNOWN_EVENT_NAMES or fix the "
+                    "name) — strict trace validation would reject this run",
+                    name=name))
+        # reverse direction: registered names / schemas never emitted
+        emitted_names = [(n, e) for n, e, _, _ in emitted]
+
+        def _covered(reg: str) -> bool:
+            for n, exact in emitted_names:
+                if exact and (n == reg or n.startswith(reg)):
+                    return True
+                if not exact and (n.startswith(reg) or reg.startswith(n)):
+                    return True
+            return False
+
+        registry_mod = next(
+            (m for m in mods if m.relpath.endswith("monitor/validate.py")),
+            mods[0] if mods else None)
+        for reg in sorted(set(self.schemas) | set(self.names)):
+            if not _covered(reg):
+                out.append(Finding(
+                    rule=self.name, severity="warning",
+                    path=(registry_mod.relpath if registry_mod
+                          else "monitor/validate.py"),
+                    line=0,
+                    message=(f"registered event name {reg!r} is never "
+                             "emitted by any source file — dead schema "
+                             "entry (or the emitter builds the name in a "
+                             "way the linter cannot see; suppress with a "
+                             "reason if so)"),
+                    detail={"name": reg}))
+        for pref in self.prefixes:
+            if not any(n.startswith(pref) for n, _ in emitted_names):
+                out.append(Finding(
+                    rule=self.name, severity="warning",
+                    path=(registry_mod.relpath if registry_mod
+                          else "monitor/validate.py"),
+                    line=0,
+                    message=(f"registered event prefix {pref!r} has no "
+                             "emission site in the scanned sources"),
+                    detail={"prefix": pref}))
+        return out
+
+
+class ConfigKeyUndeclaredRule(Rule):
+    """Inline string-literal config keys in config modules.
+
+    Config parsing modules (``**/config.py``) must read keys through
+    declared constants so the set of recognized keys is enumerable in
+    one place. The declared set is every string constant assigned to an
+    UPPER_CASE name in the repo's constants modules plus the scanned
+    module itself.
+    """
+
+    name = "config-key-undeclared"
+    severity = "error"
+    _registry_files = (
+        "runtime/constants.py",
+        "elasticity/constants.py",
+    )
+
+    def __init__(self, extra_declared: Iterable[str] = ()):
+        self._extra = set(extra_declared)
+
+    @staticmethod
+    def _declared_in(tree: ast.AST) -> Set[str]:
+        out: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets = [node.target]
+            else:
+                continue
+            names = [t.id for t in targets
+                     if isinstance(t, ast.Name) and t.id.isupper()]
+            if not names:
+                continue
+            if (isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, str)):
+                out.add(node.value.value)
+        return out
+
+    def check_repo(self, mods: Sequence[Module]) -> List[Finding]:
+        declared: Set[str] = set(self._extra)
+        for mod in mods:
+            if mod.relpath.endswith(self._registry_files):
+                declared |= self._declared_in(mod.tree)
+        out: List[Finding] = []
+        for mod in mods:
+            if not mod.relpath.endswith("config.py"):
+                continue
+            declared_here = declared | self._declared_in(mod.tree)
+            for node in ast.walk(mod.tree):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "get" and node.args):
+                    continue
+                key = node.args[0]
+                if not (isinstance(key, ast.Constant)
+                        and isinstance(key.value, str)):
+                    continue
+                if key.value not in declared_here:
+                    out.append(self._finding(
+                        mod, node,
+                        f"config key {key.value!r} read via .get() but "
+                        "never declared as a constant — undeclared keys "
+                        "are invisible to config validation and typo-prone",
+                        key=key.value))
+        return out
+
+
+RULES = (
+    MeshConstructionRule,
+    HostSyncInJitRule,
+    PRNGKeyInTracedRule,
+    TraceEventNamesRule,
+    ConfigKeyUndeclaredRule,
+)
+
+
+# ---------------------------------------------------------------------------
+# driver
+
+DEFAULT_SCAN_DIRS = ("deeperspeed_tpu", "scripts")
+
+
+def collect_modules(root: str,
+                    dirs: Sequence[str] = DEFAULT_SCAN_DIRS) -> List[Module]:
+    mods: List[Module] = []
+    for d in dirs:
+        base = os.path.join(root, d)
+        if os.path.isfile(base) and base.endswith(".py"):
+            m = Module.parse(base, root)
+            if m:
+                mods.append(m)
+            continue
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [x for x in dirnames
+                           if x not in ("__pycache__", ".git")]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    m = Module.parse(os.path.join(dirpath, fn), root)
+                    if m:
+                        mods.append(m)
+    return mods
+
+
+def lint_paths(root: str,
+               dirs: Sequence[str] = DEFAULT_SCAN_DIRS,
+               rules: Optional[Sequence[Rule]] = None) -> List[Finding]:
+    """Run every rule over the python sources under root/dirs."""
+    mods = collect_modules(root, dirs)
+    if rules is None:
+        rules = [cls() for cls in RULES]
+    findings: List[Finding] = []
+    for rule in rules:
+        for mod in mods:
+            findings.extend(rule.check_module(mod))
+        findings.extend(rule.check_repo(mods))
+    return findings

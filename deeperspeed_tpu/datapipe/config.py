@@ -40,8 +40,15 @@ _KNOWN_KEYS = frozenset({
     "eos_id", "curriculum",
 })
 
+# curriculum sub-block keys, declared as constants so the static
+# config-key audit can enumerate them (analysis config-key-undeclared)
+CURRICULUM_START_SEQ_LEN = "start_seq_len"
+CURRICULUM_WARMUP_STEPS = "warmup_steps"
+CURRICULUM_NUM_INTERVALS = "num_intervals"
+
 _CURRICULUM_KEYS = frozenset({
-    "start_seq_len", "warmup_steps", "num_intervals",
+    CURRICULUM_START_SEQ_LEN, CURRICULUM_WARMUP_STEPS,
+    CURRICULUM_NUM_INTERVALS,
 })
 
 
@@ -98,12 +105,13 @@ class DataPipeConfig:
                 raise ValueError(
                     f"unknown curriculum keys {sorted(unknown)}; valid "
                     f"keys: {sorted(_CURRICULUM_KEYS)}")
-            start = self.curriculum.get("start_seq_len", self.seq_len)
+            start = self.curriculum.get(CURRICULUM_START_SEQ_LEN,
+                                        self.seq_len)
             if not (1 <= int(start) <= self.seq_len):
                 raise ValueError(
                     f"curriculum.start_seq_len must be in 1..seq_len "
                     f"({self.seq_len}), got {start}")
-            if int(self.curriculum.get("warmup_steps", 0)) < 0:
+            if int(self.curriculum.get(CURRICULUM_WARMUP_STEPS, 0)) < 0:
                 raise ValueError("curriculum.warmup_steps must be >= 0")
 
     @classmethod

@@ -34,7 +34,12 @@ import numpy as np
 from ..monitor import get_monitor, trace_span
 from ..utils.logging import logger
 from .collator import SequencePacker, stack_collate
-from .config import DataPipeConfig
+from .config import (
+    CURRICULUM_NUM_INTERVALS,
+    CURRICULUM_START_SEQ_LEN,
+    CURRICULUM_WARMUP_STEPS,
+    DataPipeConfig,
+)
 from .curriculum import CurriculumStage, SeqLenCurriculum
 from .dataset import TokenShardDataset, epoch_order, order_fingerprint
 from .prefetcher import AsyncPrefetcher
@@ -81,9 +86,10 @@ class DataPipe:
             cur = dict(cfg.curriculum)
             curriculum = SeqLenCurriculum(
                 final_seq_len=cfg.seq_len,
-                start_seq_len=int(cur.get("start_seq_len", cfg.seq_len)),
-                warmup_steps=int(cur.get("warmup_steps", 1000)),
-                num_intervals=int(cur.get("num_intervals", 4)))
+                start_seq_len=int(cur.get(CURRICULUM_START_SEQ_LEN,
+                                          cfg.seq_len)),
+                warmup_steps=int(cur.get(CURRICULUM_WARMUP_STEPS, 1000)),
+                num_intervals=int(cur.get(CURRICULUM_NUM_INTERVALS, 4)))
         self.stage = CurriculumStage(curriculum, bs_schedule=bs_schedule,
                                      pad_id=cfg.pad_id)
         self.state = DataState(

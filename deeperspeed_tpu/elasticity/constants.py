@@ -23,6 +23,9 @@ MIN_TIME_DEFAULT = 0
 IGNORE_NON_ELASTIC_BATCH_INFO = "ignore_non_elastic_batch_info"
 IGNORE_NON_ELASTIC_BATCH_INFO_DEFAULT = False
 
+CANONICAL_SHARDS = "canonical_shards"
+CANONICAL_SHARDS_DEFAULT = 0
+
 PREFER_LARGER_BATCH = "prefer_larger_batch"
 PREFER_LARGER_BATCH_DEFAULT = True
 

@@ -143,6 +143,20 @@ METRIC_SPECS: Tuple[MetricSpec, ...] = (
                ("layouts", "fsdp8_zero3", "param_sharded_frac"),
                "higher", 0.0, 0.01,
                note="ZeRO-3 on fsdp must actually shard the param bytes"),
+    # static analysis (PR 14): the committed baseline findings file —
+    # error count is an exactness gate (the CLI already fails CI on
+    # errors; the ledger catches a quietly-committed regressed
+    # baseline), warnings/suppressions get one entry of slack so a
+    # deliberate new waiver doesn't read as a perf regression
+    MetricSpec("analysis.errors", "ANALYSIS_BASELINE.json",
+               ("counts", "error"), "lower", 0.0,
+               note="python -m deeperspeed_tpu.analysis must stay clean"),
+    MetricSpec("analysis.warnings", "ANALYSIS_BASELINE.json",
+               ("counts", "warning"), "lower", 0.0, 1.0),
+    MetricSpec("analysis.suppressed", "ANALYSIS_BASELINE.json",
+               ("counts", "suppressed"), "lower", 0.0, 1.0,
+               note="every new waiver needs a reason in "
+                    "ANALYSIS_SUPPRESSIONS.json"),
 )
 
 _SPECS_BY_NAME = {s.name: s for s in METRIC_SPECS}

@@ -85,7 +85,7 @@ EVENT_ARG_SCHEMAS = {
 KNOWN_EVENT_PREFIXES = (
     "engine/", "pipe/", "offload/", "comm/", "kernels/", "datapipe/",
     "resilience/", "serving/", "flight/", "run/", "goodput/", "trace/",
-    "monitor/", "perf/", "mem/", "mesh/",
+    "perf/", "mem/", "mesh/", "ablation/",
 )
 KNOWN_EVENT_NAMES = frozenset({
     "xla_compile", "recompile!", "process_name", "thread_name",

@@ -63,7 +63,7 @@ class TrainingConfig:
         )
         self.elastic_valid_world_sizes = valid_gpus
         self.elastic_canonical_shards = int(
-            elastic_dict.get("canonical_shards", 0)
+            elastic_dict.get(ec.CANONICAL_SHARDS, ec.CANONICAL_SHARDS_DEFAULT)
         )
         if self.elastic_canonical_shards < 0:
             raise ConfigError(

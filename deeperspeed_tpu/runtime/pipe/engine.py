@@ -37,6 +37,7 @@ from ...checkpoint.serialization import (
 )
 from ...monitor import get_monitor, trace_instant, trace_span
 from ...parallel.topology import DATA_AXIS, MODEL_AXIS, PIPE_AXIS
+from ...sharding.mesh import make_mesh
 from ...utils.logging import log_dist, logger
 from ...utils.timer import SynchronizedWallClockTimer, ThroughputTimer
 from .. import lr_schedules
@@ -58,13 +59,15 @@ def _stage_meshes(mesh: Optional[Mesh], num_stages: int) -> List[Mesh]:
         rest_names = tuple(n for n in mesh.axis_names if n != PIPE_AXIS)
         out = []
         for s in range(num_stages):
-            devs = np.take(mesh.devices, s, axis=axis)
+            # np.take with a scalar index on an object array hands back
+            # the bare Device, not a 0-d array — re-wrap before .ndim
+            devs = np.asarray(np.take(mesh.devices, s, axis=axis))
             if devs.ndim == 0:
                 devs = devs.reshape(1)
                 rest = (DATA_AXIS,)
             else:
                 rest = rest_names
-            out.append(Mesh(devs, rest))
+            out.append(make_mesh(devs, rest))
         return out
     if mesh is not None:
         # A mesh without a 'pipe' axis would silently drop its data axis
@@ -80,7 +83,7 @@ def _stage_meshes(mesh: Optional[Mesh], num_stages: int) -> List[Mesh]:
     out = []
     for s in range(num_stages):
         d = devices[s % len(devices)]
-        out.append(Mesh(np.array([d]), (DATA_AXIS,)))
+        out.append(make_mesh(np.array([d]), (DATA_AXIS,)))
     return out
 
 

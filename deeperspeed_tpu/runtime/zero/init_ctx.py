@@ -27,6 +27,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ...parallel.topology import DATA_AXIS
+from ...sharding.mesh import make_mesh
 from ...utils.logging import logger
 from . import partition
 
@@ -41,7 +42,7 @@ class Init:
                  enabled: bool = True, dtype=None):
         if mesh is None:
             devs = jax.devices()
-            mesh = Mesh(np.array(devs), (DATA_AXIS,))
+            mesh = make_mesh(np.array(devs), (DATA_AXIS,))
         self.mesh = mesh
         self.remote_device = remote_device
         self.enabled = enabled

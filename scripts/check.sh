@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# Pre-merge static gate: ruff -> analysis CLI -> strict trace
+# validation -> perf-ledger regression check. Run from anywhere; every
+# step must pass (ruff is skipped with a note on hosts that don't have
+# it — the [tool.ruff] config in pyproject.toml still applies wherever
+# ruff exists, e.g. CI).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== ruff =="
+if command -v ruff >/dev/null 2>&1; then
+    ruff check .
+else
+    echo "ruff not installed — skipped (config lives in pyproject.toml [tool.ruff])"
+fi
+
+echo "== analysis (AST linter + compiled-program audit) =="
+python -m deeperspeed_tpu.analysis
+
+echo "== strict trace validation =="
+for trace in traces/*.json; do
+    [ -e "$trace" ] || continue
+    JAX_PLATFORMS=cpu python -m deeperspeed_tpu.monitor.validate --strict "$trace"
+    echo "  $trace OK"
+done
+
+echo "== perf ledger =="
+JAX_PLATFORMS=cpu python -m deeperspeed_tpu.monitor.ledger check
+
+echo "check.sh: all gates passed"

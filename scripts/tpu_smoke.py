@@ -443,6 +443,28 @@ def main():
     else:
         print("  mesh substrate skipped: needs an even multi-device host")
 
+    # static analysis on REAL lowerings: the CPU CI audit proves the
+    # programs are clean on a virtual mesh; the alias table, collective
+    # layout, and callback set can all differ once Mosaic/XLA-TPU
+    # compile the same entry points, so re-audit on the chip
+    from deeperspeed_tpu.analysis import audit_default_programs
+
+    def analysis_audit():
+        notes = []
+        findings = audit_default_programs(notes)
+        for n in notes:
+            print(f"    note: {n}")
+        # no suppression file applies here: AST waivers don't cover
+        # program audits, so every error-level finding is real
+        errors = [f for f in findings if f.severity == "error"]
+        for f in findings:
+            print(f"    {f.severity}: {f.rule} @ {f.path}: {f.message}")
+        assert not errors, f"{len(errors)} error-level audit finding(s)"
+        return jnp.zeros(())
+
+    _check("static program audit (donation/collective/callback)",
+           analysis_audit)
+
     print("ALL KERNELS OK on hardware")
     return 0
 

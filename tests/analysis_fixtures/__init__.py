@@ -1,0 +1,7 @@
+"""Planted-violation fixtures for tests/test_analysis.py.
+
+Each module contains exactly the violations its name says. They are
+parsed by the AST linter, never imported or executed, and live under
+tests/ so the repo-wide CLI scan (deeperspeed_tpu/ + scripts/) never
+sees them.
+"""

@@ -1,0 +1,11 @@
+"""Planted violation: undeclared string-literal config key in a
+config module (the filename ends in config.py on purpose)."""
+
+MY_DECLARED_KEY = "declared_key"
+
+
+def parse(pd):
+    ok = pd.get(MY_DECLARED_KEY, 0)          # fine: via constant
+    also_ok = pd.get("declared_key", 1)      # fine: literal but declared
+    bad = pd.get("mystery_knob", None)       # config-key-undeclared
+    return ok, also_ok, bad

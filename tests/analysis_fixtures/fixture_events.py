@@ -1,0 +1,18 @@
+"""Planted violation: trace event names outside the strict registry.
+
+The test drives TraceEventNamesRule over this file with a synthetic
+registry (prefixes=("x/",), names={"known_lone"}, schemas={"x/s": ...})
+so both directions are exercised: "bogus/evt" is emitted but
+unregistered, and "known_lone" is registered but never emitted here.
+"""
+
+
+def trace_instant(name, **kw):
+    return name, kw
+
+
+def emit(tracer):
+    trace_instant("bogus/evt", v=1)       # trace-event-names (unregistered)
+    trace_instant("x/s", a=2)             # fine: registered schema name
+    with tracer.span(f"x/dyn[{3}]"):      # fine: dynamic under known prefix
+        pass

@@ -1,0 +1,23 @@
+"""Planted violation: host syncs inside traced functions."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step_with_item(x):
+    bad = x.sum().item()  # host-sync-in-jit
+    return x * bad
+
+
+def _body(x):
+    jax.block_until_ready(x)  # host-sync-in-jit (referenced via jit below)
+    return jax.device_get(x)  # host-sync-in-jit
+
+
+traced = jax.jit(_body)
+
+
+def host_side_ok(x):
+    # NOT traced: syncing here is fine and must not be flagged
+    return jax.block_until_ready(x)

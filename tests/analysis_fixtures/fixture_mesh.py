@@ -1,0 +1,14 @@
+"""Planted violation: raw Mesh() construction outside sharding/mesh.py."""
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def build_rogue_mesh():
+    return Mesh(np.array(jax.devices()), ("data",))  # mesh-construction
+
+
+def build_rogue_mesh_dotted():
+    return jax.sharding.Mesh(  # mesh-construction (multi-line, dotted)
+        np.array(jax.devices()), ("data",))

@@ -1,0 +1,18 @@
+"""Planted violation: fresh PRNGKey inside a traced step function."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def step(state, batch):
+    key = jax.random.PRNGKey(0)  # prngkey-in-traced
+    noise = jax.random.normal(key, batch.shape)
+    return state + batch + noise
+
+
+def host_side_ok():
+    # NOT traced: building a key on the host is the correct pattern
+    return jax.random.PRNGKey(0)
