@@ -1,0 +1,36 @@
+"""lifecycle/: zero-downtime train→serve control plane.
+
+Two capabilities the rest of the stack composes:
+
+  * **Live re-mesh** — on a pool-change signal the trainer pauses at a
+    step boundary and rebuilds its mesh in-process (``Engine.remesh``):
+    ``jax.device_put`` re-placement onto the surviving devices plus the
+    ``resilience/reshard.py`` residual math, no checkpoint round trip,
+    no re-exec. Losses stay bit-identical to the kill-restart path.
+  * **Weight versions** — COMMITTED checkpoint tags become monotonically
+    numbered ``WeightVersion`` records (``VERSIONS.json``); the fleet
+    router rolling-restarts replicas onto new versions with
+    mixed-version routing, and failover retries stay pinned to the
+    version that served the first dispatch.
+
+``python -m deeperspeed_tpu.lifecycle`` is the operator CLI (inspect /
+publish / retire versions, poke the pool file); the drill lives in
+``scripts/lifecycle_drill.py``.
+"""
+
+from .config import LifecycleConfig
+from .controller import LifecycleController, RolloutDriver, VersionPublisher
+from .remesh import RemeshHook
+from .versions import VERSIONS_FILE, VersionRegistry, WeightVersion, live_tags
+
+__all__ = [
+    "LifecycleConfig",
+    "LifecycleController",
+    "RolloutDriver",
+    "VersionPublisher",
+    "RemeshHook",
+    "VERSIONS_FILE",
+    "VersionRegistry",
+    "WeightVersion",
+    "live_tags",
+]

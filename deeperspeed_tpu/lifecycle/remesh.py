@@ -1,0 +1,162 @@
+"""Live re-mesh hook: pool-change signal → in-process topology flip.
+
+The kill-free half of elasticity. PR 7's path is: supervisor sees the
+pool change, SIGKILLs the trainer, relaunches at the new world size, the
+checkpoint loader reshards. This hook keeps the process alive instead:
+the supervisor (or an operator) sends ``SIGUSR1`` to the RUNNING
+trainer; the handler just latches a flag (signal context does no work);
+at the next optimizer-step boundary :meth:`RemeshHook.poll` re-reads the
+pool file, picks the largest admissible elastic world size that fits,
+and calls :meth:`Engine.remesh` — ``jax.device_put`` re-placement plus
+the same ``resilience/reshard.py`` residual math, no checkpoint round
+trip, no re-exec.
+
+Wiring: the resilience manager calls ``poll`` from its step-boundary
+hook when a hook is attached (``attach_lifecycle``), so any engine with
+a ``resilience`` block gets live re-mesh by adding a ``lifecycle``
+block; a bare training loop can call ``hook.poll(engine)`` itself.
+
+A pool *grow* beyond the process's device count cannot happen live (the
+JAX device list is fixed at process start) — ``choose_world`` caps at
+``len(jax.devices())`` and the supervisor's relaunch path still owns
+growth.
+"""
+
+import os
+import signal
+import time
+from typing import Optional
+
+from ..resilience.supervisor import POOL_FILE_ENV
+from ..utils.logging import logger
+from .config import LifecycleConfig
+
+__all__ = ["RemeshHook"]
+
+
+class RemeshHook:
+    """Latches the re-mesh signal and applies it at step boundaries."""
+
+    def __init__(self, cfg: Optional[LifecycleConfig] = None,
+                 pool_file: Optional[str] = None):
+        self.cfg = cfg or LifecycleConfig()
+        self.pool_file = (pool_file or self.cfg.pool_file
+                          or os.environ.get(POOL_FILE_ENV))
+        self._pending = 0
+        self._signal_ts = 0.0
+        self._prev_handler = None
+        self._installed = False
+        self.remeshes = 0        # applied flips
+        self.last_world: Optional[int] = None
+
+    # -------------------------------------------------------------- #
+    # signal side (async-signal-safe: only sets flags)
+
+    def install(self) -> "RemeshHook":
+        """Register the signal handler (main thread only, per signal
+        module rules). Idempotent."""
+        if self._installed:
+            return self
+        try:
+            self._prev_handler = signal.signal(
+                self.cfg.signal_number(), self._on_signal)
+        except ValueError:
+            # not the main thread: signals can't be claimed here, but
+            # request() / poll() still work for in-process controllers
+            logger.warning(
+                "lifecycle: cannot install the re-mesh signal handler "
+                "off the main thread; use hook.request() instead")
+            return self
+        self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        if self._installed:
+            signal.signal(self.cfg.signal_number(),
+                          self._prev_handler or signal.SIG_DFL)
+            self._installed = False
+
+    def _on_signal(self, signum, frame) -> None:
+        self._pending += 1
+        self._signal_ts = time.time()
+
+    def request(self) -> None:
+        """Programmatic trigger (tests / same-process controllers)."""
+        self._on_signal(None, None)
+
+    @property
+    def pending(self) -> bool:
+        return self._pending > 0
+
+    # -------------------------------------------------------------- #
+    # step-boundary side
+
+    def read_pool(self) -> Optional[int]:
+        """The surviving pool's device count, or None when unreadable."""
+        if not self.pool_file:
+            return None
+        try:
+            with open(self.pool_file) as f:
+                return int(f.read().strip())
+        except (OSError, ValueError) as e:
+            logger.warning("lifecycle: unreadable pool file %s (%s)",
+                           self.pool_file, e)
+            return None
+
+    def choose_world(self, engine) -> Optional[int]:
+        """Largest admissible elastic world size fitting the pool AND
+        this process's fixed device count."""
+        import jax
+
+        sizes = list(getattr(engine._config,
+                             "elastic_valid_world_sizes", None) or [])
+        if not sizes:
+            logger.warning(
+                "lifecycle: re-mesh signal with no elasticity block — "
+                "no admissible world sizes, staying at %d",
+                engine.data_parallel_size)
+            return None
+        cap = len(jax.devices())
+        pool = self.read_pool()
+        if pool is not None:
+            cap = min(cap, pool)
+        admissible = [s for s in sizes if s <= cap]
+        if not admissible:
+            logger.error(
+                "lifecycle: no elastic world size fits the pool of %s "
+                "(valid: %s); keeping the current topology", pool, sizes)
+            return None
+        return max(admissible)
+
+    def poll(self, engine) -> bool:
+        """Called at an optimizer-step boundary. Applies at most one
+        re-mesh; True when the topology changed. Signal bursts within
+        ``remesh_debounce_s`` coalesce — the flip waits for a boundary
+        where the pool file has been quiet."""
+        if not self._pending or not self.cfg.remesh_enabled:
+            return False
+        if (self.cfg.remesh_debounce_s > 0.0
+                and time.time() - self._signal_ts
+                < self.cfg.remesh_debounce_s):
+            return False  # still settling; re-check next boundary
+        self._pending = 0
+        world = self.choose_world(engine)
+        if world is None or world == engine.data_parallel_size:
+            if world is not None:
+                logger.info(
+                    "lifecycle: pool change resolves to the current "
+                    "world size (%d); nothing to do", world)
+            return False
+        engine.remesh(world)
+        self.remeshes += 1
+        self.last_world = world
+        monitor = getattr(engine, "monitor", None)
+        if monitor is not None:
+            monitor.registry.counter(
+                "lifecycle_remesh_total",
+                "live in-process re-mesh flips applied").inc()
+            monitor.registry.gauge(
+                "lifecycle_world_size",
+                "data-parallel world size after the last re-mesh",
+            ).set(float(world))
+        return True

@@ -20,6 +20,9 @@ span is compile time, not productive time):
 
   ====================  =============================================
   ``compile``           ``xla_compile`` instants (duration in args)
+  ``remesh``            ``lifecycle/remesh`` spans — live in-process
+                        topology flips (the zero-restart elasticity
+                        path pays a stall, not a relaunch)
   ``checkpoint``        ``resilience/write|snapshot|commit`` spans
   ``stall``             ``datapipe/wait`` spans
   ``rework``            train-step spans whose ``step`` arg was
@@ -32,8 +35,8 @@ span is compile time, not productive time):
                         (imports, engine build, resume/reshard)
   ====================  =============================================
 
-Precedence within an incarnation: compile > checkpoint > stall >
-rework > productive; each category is measured after subtracting the
+Precedence within an incarnation: compile > remesh > checkpoint >
+stall > rework > productive; each category is measured after subtracting the
 higher ones, and ``other`` is the unclassified remainder, so the
 buckets sum to measured wall-clock by construction — the drill audits
 the sum against an independently measured wall time to within 5%.
@@ -68,8 +71,8 @@ __all__ = [
     "main",
 ]
 
-BUCKETS = ("productive", "rework", "compile", "checkpoint", "stall",
-           "restart", "other")
+BUCKETS = ("productive", "rework", "compile", "remesh", "checkpoint",
+           "stall", "restart", "other")
 
 # span names whose time is the run's actual point: training or serving
 # forward progress
@@ -80,6 +83,7 @@ PRODUCTIVE_SPANS = frozenset({
 CHECKPOINT_SPANS = frozenset({
     "resilience/write", "resilience/snapshot", "resilience/commit",
 })
+REMESH_SPANS = frozenset({"lifecycle/remesh"})
 STALL_SPANS = frozenset({"datapipe/wait"})
 COMPILE_INSTANT = "xla_compile"
 
@@ -168,7 +172,7 @@ def classify_incarnation(events: List[dict], prev_max_step: int,
     """One incarnation's trace -> seconds per in-child bucket, plus the
     updated max step index seen (feeds the next incarnation's rework
     detection). Pure; the drill's synthetic-log test drives it."""
-    compile_iv, ckpt_iv, stall_iv = [], [], []
+    compile_iv, remesh_iv, ckpt_iv, stall_iv = [], [], [], []
     prod_iv, rework_iv = [], []
     max_step = prev_max_step
     for ev in events:
@@ -189,7 +193,9 @@ def classify_incarnation(events: List[dict], prev_max_step: int,
         if not isinstance(dur, (int, float)) or dur <= 0:
             continue
         iv = (ts, ts + dur)
-        if name in CHECKPOINT_SPANS:
+        if name in REMESH_SPANS:
+            remesh_iv.append(iv)
+        elif name in CHECKPOINT_SPANS:
             ckpt_iv.append(iv)
         elif name in STALL_SPANS:
             stall_iv.append(iv)
@@ -202,8 +208,10 @@ def classify_incarnation(events: List[dict], prev_max_step: int,
             if isinstance(step, (int, float)):
                 max_step = max(max_step, int(step))
     compile_u = interval_union(compile_iv)
-    ckpt_u = interval_subtract(interval_union(ckpt_iv), compile_u)
-    higher = interval_union(compile_u + ckpt_u)
+    remesh_u = interval_subtract(interval_union(remesh_iv), compile_u)
+    higher = interval_union(compile_u + remesh_u)
+    ckpt_u = interval_subtract(interval_union(ckpt_iv), higher)
+    higher = interval_union(higher + ckpt_u)
     stall_u = interval_subtract(interval_union(stall_iv), higher)
     higher = interval_union(higher + stall_u)
     rework_u = interval_subtract(interval_union(rework_iv), higher)
@@ -214,6 +222,7 @@ def classify_incarnation(events: List[dict], prev_max_step: int,
         "productive": interval_measure(prod_u) * to_s,
         "rework": interval_measure(rework_u) * to_s,
         "compile": interval_measure(compile_u) * to_s,
+        "remesh": interval_measure(remesh_u) * to_s,
         "checkpoint": interval_measure(ckpt_u) * to_s,
         "stall": interval_measure(stall_u) * to_s,
     }, max_step

@@ -143,6 +143,27 @@ METRIC_SPECS: Tuple[MetricSpec, ...] = (
                ("layouts", "fsdp8_zero3", "param_sharded_frac"),
                "higher", 0.0, 0.01,
                note="ZeRO-3 on fsdp must actually shard the param bytes"),
+    # lifecycle (PR 15): zero-downtime train→serve. Losing an accepted
+    # request across a weight push, a non-bit-identical live re-mesh,
+    # or restart downtime during a pool shrink are exactness gates; the
+    # re-mesh stall itself is CPU wall clock and gets a wide band
+    MetricSpec("lifecycle.lost_accepted", "BENCH_lifecycle.json",
+               ("serving", "lost_accepted"), "lower", 0.0,
+               note="weight pushes + pool shrink must not lose accepted "
+                    "requests"),
+    MetricSpec("lifecycle.max_loss_delta", "BENCH_lifecycle.json",
+               ("remesh", "max_loss_delta"), "lower", 0.0, 1e-9,
+               note="live re-mesh must match the kill-restart reshard "
+                    "losses bit-for-bit"),
+    MetricSpec("lifecycle.weight_pushes", "BENCH_lifecycle.json",
+               ("weight_pushes",), "higher", 0.0),
+    MetricSpec("lifecycle.goodput.restart_s", "BENCH_lifecycle.json",
+               ("goodput", "restart_s"), "lower", 0.0, 0.5,
+               note="the live path keeps the process up: shrink "
+                    "downtime lands in `remesh`, not `restart`"),
+    MetricSpec("lifecycle.remesh_stall_s", "BENCH_lifecycle.json",
+               ("remesh", "stall_s"), "lower", 1.00, 5.0,
+               note="cpu wall clock: wide band"),
     # static analysis (PR 14): the committed baseline findings file —
     # error count is an exactness gate (the CLI already fails CI on
     # errors; the ledger catches a quietly-committed regressed

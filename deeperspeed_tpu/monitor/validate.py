@@ -78,6 +78,14 @@ EVENT_ARG_SCHEMAS = {
     # and post-hoc layout debugging join on these
     "mesh/build": ("axes", "devices"),
     "mesh/audit": ("tree", "sharded_frac", "digest"),
+    # lifecycle control plane: every live re-mesh span names both
+    # topologies (the goodput `remesh` bucket and the drill's audit
+    # join on it); publishes/rollouts/repins carry the version so
+    # mixed-version routing is reconstructible from the trace alone
+    "lifecycle/remesh": ("world_from", "world_to"),
+    "lifecycle/publish": ("version", "tag", "step"),
+    "lifecycle/rollout": ("replica", "version"),
+    "lifecycle/repin": ("rid", "version"),
 }
 
 # strict-mode name discipline: one prefix per subsystem that emits
@@ -85,7 +93,7 @@ EVENT_ARG_SCHEMAS = {
 KNOWN_EVENT_PREFIXES = (
     "engine/", "pipe/", "offload/", "comm/", "kernels/", "datapipe/",
     "resilience/", "serving/", "flight/", "run/", "goodput/", "trace/",
-    "perf/", "mem/", "mesh/", "ablation/",
+    "perf/", "mem/", "mesh/", "ablation/", "lifecycle/",
 )
 KNOWN_EVENT_NAMES = frozenset({
     "xla_compile", "recompile!", "process_name", "thread_name",

@@ -55,6 +55,7 @@ class ResilienceManager:
             self.guard = PreemptionGuard(signals=config.preemption_signals)
             self.guard.install()
         self.serving = []  # live serving engines to drain on preemption
+        self.lifecycle = []  # step-boundary hooks (re-mesh, publish)
         self._save_dir = config.save_dir
         self._warned_multiprocess = False
         self._warned_no_save_dir = False
@@ -203,7 +204,9 @@ class ResilienceManager:
         tag ``latest`` points at, the tag this run resumed from (it may
         be the only state that predates an in-flight experiment), nor
         the newest committed tag (an async save racing the interval
-        autosave must never leave the directory empty of valid tags)."""
+        autosave must never leave the directory empty of valid tags),
+        nor any tag published as a LIVE weight version (the serving
+        fleet may still be routing to — or rolling onto — it)."""
         from ..checkpoint.serialization import read_latest
 
         committed = [t for t in list_tags(save_dir)
@@ -211,6 +214,12 @@ class ResilienceManager:
         protected = {read_latest(save_dir), self._resumed_tag}
         if committed:
             protected.add(committed[0])  # newest committed
+        try:
+            from ..lifecycle.versions import live_tags
+
+            protected |= set(live_tags(save_dir))
+        except Exception:  # noqa: BLE001 - retention is advisory
+            pass
         for tag in committed[keep:]:
             if tag in protected:
                 continue
@@ -236,7 +245,10 @@ class ResilienceManager:
     def on_step_boundary(self, engine) -> None:
         """Called by the engine after every optimizer step: fault
         injection first (drills want the crash exactly where a real one
-        lands), then preemption, then interval autosave."""
+        lands), then preemption, then interval autosave, then the
+        lifecycle hooks (version publish sees the fresh checkpoint; a
+        pending live re-mesh lands AFTER the save so the tag predates
+        the flip)."""
         if self.faults.armed:
             self.faults.on_step(engine.global_steps)
         if self.guard is not None and self.guard.requested:
@@ -252,6 +264,8 @@ class ResilienceManager:
                     "resilience.save_interval_steps is set but no save "
                     "dir is known (set resilience.save_dir or call "
                     "save_checkpoint once); autosaves skipped")
+        for hook in list(self.lifecycle):
+            hook.poll(engine)
 
     def handle_preemption(self, engine) -> None:
         """The orderly-exit protocol: urgent checkpoint, drain pending
@@ -346,6 +360,13 @@ class ResilienceManager:
     def attach_serving(self, serving_engine) -> None:
         if serving_engine not in self.serving:
             self.serving.append(serving_engine)
+
+    def attach_lifecycle(self, hook) -> None:
+        """Register a lifecycle step-boundary hook (anything with a
+        ``poll(engine)`` method — the RemeshHook, the version
+        publisher); polled after fault/preemption/autosave handling."""
+        if hook not in self.lifecycle:
+            self.lifecycle.append(hook)
 
     # ------------------------------------------------------------------ #
 

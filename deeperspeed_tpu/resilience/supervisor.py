@@ -42,8 +42,10 @@ import json
 import os
 import random
 import re
+import signal
 import subprocess
 import sys
+import threading
 import time
 from dataclasses import dataclass
 from typing import Callable, List, Optional, Sequence
@@ -64,6 +66,9 @@ RESTART_COUNT_ENV = "DS_TPU_RESTART_COUNT"
 RESTART_REASON_ENV = "DS_TPU_RESTART_REASON"
 ELASTIC_WORLD_SIZES_ENV = "DS_TPU_ELASTIC_WORLD_SIZES"
 WORLD_SIZE_ENV = "DS_TPU_WORLD_SIZE"
+# exported so the child's lifecycle re-mesh hook re-reads the SAME pool
+# file the supervisor watches when the re-mesh signal arrives
+POOL_FILE_ENV = "DS_TPU_POOL_FILE"
 
 
 def compute_backoff(failures: int, base: float, factor: float,
@@ -99,6 +104,16 @@ class SupervisorPolicy:
     # re-read before every (re)start; the supervisor picks the largest
     # admissible elastic world size that fits and exports it
     pool_file: Optional[str] = None
+    # lifecycle live re-mesh: watch the pool file WHILE the child runs
+    # and signal the running trainer (remesh_signal) instead of waiting
+    # for the next relaunch — the child's lifecycle.RemeshHook flips the
+    # topology in process. Writes are debounced (a pool update must hold
+    # still for pool_debounce_s) so an editor's write-rename or a burst
+    # of shrink events resolves to one signal.
+    watch_pool: bool = False
+    pool_poll_interval_s: float = 0.25
+    pool_debounce_s: float = 0.5
+    remesh_signal: int = signal.SIGUSR1
     restart_log: Optional[str] = None  # JSONL transition record
     # drills: also export JAX_PLATFORMS=cpu + --xla_force_host_platform_
     # device_count so the chosen world size becomes real CPU devices
@@ -119,14 +134,69 @@ class Supervisor:
         self.crashes = 0  # non-preemption failures (drives backoff/cap)
         self.history: List[int] = []  # child return codes, in order
         self.world_history: List[Optional[int]] = []  # world per launch
+        self.remesh_signals = 0  # live re-mesh signals sent to children
         self._last_reason: Optional[str] = None  # why the NEXT launch is one
         # run-scoped observability: every incarnation of this run shares
         # one run_id; the child's role/incarnation label its trace lane
         self.run_id = ensure_run_id()
 
-    @staticmethod
-    def _run_subprocess(cmd: List[str], env: dict) -> int:
-        return subprocess.call(cmd, env=env)
+    def _run_subprocess(self, cmd: List[str], env: dict) -> int:
+        """Default run_fn: Popen (not call) so the pool watcher can
+        signal the RUNNING child for a live re-mesh."""
+        proc = subprocess.Popen(cmd, env=env)
+        stop = watcher = None
+        if self.policy.watch_pool and self.policy.pool_file:
+            stop = threading.Event()
+            watcher = threading.Thread(
+                target=self._watch_pool, args=(proc, stop), daemon=True)
+            watcher.start()
+        try:
+            return proc.wait()
+        finally:
+            if stop is not None:
+                stop.set()
+                watcher.join(timeout=5.0)
+
+    def _read_pool(self) -> Optional[int]:
+        try:
+            with open(self.policy.pool_file) as f:
+                return int(f.read().strip())
+        except (OSError, ValueError):
+            return None
+
+    def _watch_pool(self, proc: "subprocess.Popen", stop: threading.Event
+                    ) -> None:
+        """Poll the pool file while the child runs; a debounced value
+        change sends the re-mesh signal and records a ``remesh``
+        transition (distinct from crash/preemption relaunches) in the
+        restart log."""
+        pol = self.policy
+        last = self._read_pool()
+        pending_val: Optional[int] = None
+        pending_since = 0.0
+        while not stop.wait(pol.pool_poll_interval_s):
+            val = self._read_pool()
+            if val is None or val == last:
+                pending_val = None
+                continue
+            now = time.time()
+            if val != pending_val:
+                pending_val, pending_since = val, now  # (re)start debounce
+                continue
+            if now - pending_since < pol.pool_debounce_s:
+                continue
+            last, pending_val = val, None
+            try:
+                proc.send_signal(pol.remesh_signal)
+            except OSError:
+                return  # child already gone; run() handles the exit
+            self.remesh_signals += 1
+            logger.info(
+                "supervisor: pool file now %d — sent signal %d for a "
+                "live re-mesh (no restart)", val, int(pol.remesh_signal))
+            self._log_event({"event": "remesh", "reason": "pool_change",
+                             "pool": val,
+                             "signal": int(pol.remesh_signal)})
 
     # ------------------------------------------------------------------ #
 
@@ -164,6 +234,9 @@ class Supervisor:
             if sizes:
                 env[ELASTIC_WORLD_SIZES_ENV] = ",".join(map(str, sizes))
                 logger.info("supervisor: elastic world sizes %s", sizes)
+        if pol.pool_file:
+            # the child's lifecycle re-mesh hook reads the same pool file
+            env[POOL_FILE_ENV] = pol.pool_file
         world = self._choose_world(sizes)
         self.world_history.append(world)
         if world is not None:
@@ -320,6 +393,19 @@ def build_parser() -> argparse.ArgumentParser:
                    help="file holding the surviving pool's device count; "
                         "re-read before every launch to pick the largest "
                         "admissible elastic world size")
+    p.add_argument("--watch-pool", action="store_true",
+                   help="watch --pool-file while the child runs and send "
+                        "--remesh-signal on a (debounced) change so the "
+                        "trainer re-meshes live instead of restarting")
+    p.add_argument("--pool-debounce", type=float, default=0.5,
+                   metavar="S", help="pool-file writes must hold still "
+                                     "this long before the signal fires")
+    p.add_argument("--pool-poll-interval", type=float, default=0.25,
+                   metavar="S", help="pool-file polling period")
+    p.add_argument("--remesh-signal", type=int,
+                   default=int(signal.SIGUSR1),
+                   help="signal number sent to the running child on a "
+                        "pool change (default SIGUSR1)")
     p.add_argument("--restart-log", default=None, metavar="JSONL",
                    help="append one JSON record per launch/exit "
                         "transition (reason, world size, resume tag)")
@@ -353,6 +439,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         elastic_config=args.elastic_config,
         verify_checksums=not args.no_verify,
         pool_file=args.pool_file,
+        watch_pool=args.watch_pool,
+        pool_poll_interval_s=args.pool_poll_interval,
+        pool_debounce_s=args.pool_debounce,
+        remesh_signal=args.remesh_signal,
         restart_log=args.restart_log,
         simulate_cpu_devices=args.simulate_cpu_devices,
     )

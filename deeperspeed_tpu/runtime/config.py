@@ -451,6 +451,34 @@ class TrainingConfig:
             except ValueError as e:
                 raise ConfigError(f'invalid "mesh" block: {e}') from e
 
+        # ---- lifecycle (train→serve control plane) ----
+        # A "lifecycle" block arms live re-mesh (pool-change signal →
+        # in-process topology flip at a step boundary) and weight-
+        # version publishing (COMMITTED tags → VERSIONS.json records
+        # the serving fleet rolls onto). Validated eagerly so a typo'd
+        # signal name fails at load time.
+        self.lifecycle_params = pd.get(c.LIFECYCLE, None)
+        if self.lifecycle_params is not None and not isinstance(
+                self.lifecycle_params, dict):
+            raise ConfigError(
+                '"lifecycle" must be a dict of LifecycleConfig '
+                'overrides (or {"enabled": false})'
+            )
+        explicit_lc = (self.lifecycle_params or {}).get(c.LIFECYCLE_ENABLED)
+        self.lifecycle_enabled = (
+            explicit_lc if explicit_lc is not None
+            else self.lifecycle_params is not None
+        )
+        self._lifecycle_config = None
+        if self.lifecycle_enabled:
+            from ..lifecycle.config import LifecycleConfig
+
+            try:
+                self._lifecycle_config = LifecycleConfig.from_dict(
+                    dict(self.lifecycle_params, enabled=True))
+            except ValueError as e:
+                raise ConfigError(f'invalid "lifecycle" block: {e}') from e
+
         # ---- fused Pallas kernels ----
         # A "kernels" block selects the fused elementwise/optimizer/
         # super-tile attention kernels (ops/kernel_config.py): mode
@@ -513,6 +541,11 @@ class TrainingConfig:
         """The "mesh" block as a sharding.MeshConfig (None when absent
         or disabled); validated at parse time like "comm"."""
         return self._mesh_config
+
+    def lifecycle_config(self):
+        """The "lifecycle" block as a LifecycleConfig (None when absent
+        or disabled); validated at parse time like "mesh"."""
+        return self._lifecycle_config
 
     def get_sparse_attention(self, num_heads: int):
         """Build the configured SparsityConfig (reference runtime/config.py:213

@@ -290,3 +290,16 @@ COMM_ENABLED_DEFAULT = False
 MESH = "mesh"
 MESH_ENABLED = "enabled"
 MESH_ENABLED_DEFAULT = False
+
+#############################################
+# Train→serve lifecycle (lifecycle/ package): live in-process re-mesh
+# on pool-change signals (no checkpoint round trip, no re-exec) and
+# weight-version publishing — COMMITTED checkpoint tags become
+# monotonically numbered WeightVersion records the serving fleet
+# rolling-restarts onto. Keys are validated by
+# lifecycle.config.LifecycleConfig.from_dict; block presence enables
+# unless {"enabled": false}.
+#############################################
+LIFECYCLE = "lifecycle"
+LIFECYCLE_ENABLED = "enabled"
+LIFECYCLE_ENABLED_DEFAULT = False

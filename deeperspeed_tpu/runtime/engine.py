@@ -243,6 +243,33 @@ class Engine(ConfigAccessorsMixin):
             # supervisor-restarted child: count it + record reason/world
             self._resilience.note_restart_context()
 
+        # lifecycle (lifecycle/ package): a "lifecycle" block arms the
+        # live re-mesh signal handler and the weight-version publisher
+        # as resilience step-boundary hooks; the publisher needs a
+        # checkpoint dir, so wiring waits for the first known save dir
+        # when resilience.save_dir is unset
+        self._lifecycle = None
+        lc_cfg = config.lifecycle_config()
+        if lc_cfg is not None:
+            from ..lifecycle.controller import LifecycleController
+
+            ckpt_dir = (self._resilience.save_dir
+                        if self._resilience is not None else None)
+            if ckpt_dir is not None:
+                self._lifecycle = LifecycleController(
+                    ckpt_dir, cfg=lc_cfg).attach(self)
+            else:
+                # no checkpoint dir to publish from: still honor the
+                # re-mesh half so pool shrinks work checkpoint-free
+                from ..lifecycle.remesh import RemeshHook
+
+                hook = RemeshHook(lc_cfg)
+                if lc_cfg.remesh_enabled:
+                    hook.install()
+                if self._resilience is not None:
+                    self._resilience.attach_lifecycle(hook)
+                self._lifecycle = hook
+
         # the fused train step legitimately traces twice: the initial
         # state is an uncommitted single-device array, the step's output
         # commits to a NamedSharding over the mesh, and the second call
@@ -1806,6 +1833,223 @@ class Engine(ConfigAccessorsMixin):
             logger.warning(
                 "comm residual restore failed (%s): error feedback "
                 "restarts from zero", e)
+
+    # ------------------------------------------------------------------ #
+    # live re-mesh (lifecycle/)
+    # ------------------------------------------------------------------ #
+
+    def remesh(self, world_size: int, devices=None):
+        """Flip the data-parallel topology IN PROCESS at a step boundary.
+
+        The kill-free counterpart of the supervisor's elastic relaunch:
+        instead of checkpoint → SIGKILL → re-exec → reshard-on-load, the
+        running engine rebuilds the mesh over ``devices`` (default: the
+        first ``world_size`` local devices — a pool *shrink*; growth past
+        the process's fixed device count still needs a relaunch),
+        re-places every ``EngineState`` leaf with ``jax.device_put`` onto
+        the new specs, rebuilds the GradReducer plan and reshards its
+        error-feedback residuals via ``resilience/reshard.py`` — all
+        without a checkpoint round trip. With canonical-slot reduction
+        (``elasticity.canonical_shards``) the loss curve continues
+        bit-identically, exactly as a kill-restart resume would.
+
+        Requires an ``elasticity`` block (it re-solves the micro/gas
+        batch split at the new world size with the global batch — and
+        therefore the datapipe row stream — invariant) and a clean
+        accumulation boundary (no banked gradients in flight).
+        """
+        if world_size == self.data_parallel_size:
+            return self.data_parallel_size
+        if self._offload is not None:
+            raise RuntimeError(
+                "live re-mesh is not supported with optimizer offload "
+                "(host-side state is keyed to the old placement)")
+        if self._acc_count or self._stashed is not None:
+            raise RuntimeError(
+                "live re-mesh must happen at an optimizer-step boundary "
+                "(gradients are banked mid-accumulation)")
+        if not self._config.elasticity_enabled:
+            raise RuntimeError(
+                "live re-mesh needs an elasticity block: the batch "
+                "triple must re-solve at the new world size with the "
+                "global batch invariant")
+        valid = self._config.elastic_valid_world_sizes or []
+        if valid and world_size not in valid:
+            raise ValueError(
+                f"world_size {world_size} is not an admissible elastic "
+                f"world size (valid: {sorted(valid)})")
+        if devices is None:
+            local = jax.devices()
+            if world_size > len(local):
+                raise ValueError(
+                    f"cannot re-mesh to {world_size} devices in process: "
+                    f"only {len(local)} exist (growth needs a relaunch)")
+            devices = local[:world_size]
+
+        old_world = self.data_parallel_size
+        t0 = time.time()
+        # the span COVERS the re-placement stall — the goodput ledger's
+        # `remesh` bucket is carved from exactly this interval
+        with trace_span("lifecycle/remesh", lane="lifecycle",
+                        world_from=old_world, world_to=world_size):
+            new_dp = self._remesh_apply(world_size, devices)
+        stall_ms = (time.time() - t0) * 1000.0
+        log_dist(
+            f"live re-mesh: world {old_world} -> {new_dp} in "
+            f"{stall_ms:.0f}ms (step {self.global_steps}, "
+            f"mesh={dict(self.mesh.shape)})", ranks=[0])
+        return new_dp
+
+    def _remesh_apply(self, world_size: int, devices) -> int:
+        import copy
+
+        from . import constants as _c
+
+        old_rows = self._global_rows()
+
+        # ---- snapshots the new topology must inherit ----
+        old_comm_host = old_comm_fp = old_comm_plan = None
+        if self.comm is not None:
+            old_comm_host = to_host(self._comm_state)
+            old_comm_fp = repr(self.comm.state_fingerprint())
+            old_comm_plan = self.comm.plan_summary()
+
+        # ---- re-solve the config at the new world size ----
+        # elasticity rewrote the batch triple into the param dict at
+        # init; strip it so the re-parse re-derives micro/gas for the
+        # new world (the global batch is pinned by the elasticity block)
+        raw = copy.deepcopy(self._config._param_dict)
+        for key in (_c.TRAIN_BATCH_SIZE, _c.TRAIN_MICRO_BATCH_SIZE_PER_GPU,
+                    _c.GRADIENT_ACCUMULATION_STEPS):
+            raw.pop(key, None)
+        new_config = TrainingConfig(raw, world_size=world_size)
+
+        # ---- the new mesh, over the surviving devices ----
+        mesh_cfg = new_config.mesh_config()
+        if mesh_cfg is not None:
+            new_mesh = sharding.from_config(mesh_cfg, devices)
+        else:
+            from ..parallel.topology import build_mesh
+
+            new_mesh = build_mesh({DATA_AXIS: len(devices)},
+                                  devices=devices)
+        new_dp = sharding.data_parallel_size(new_mesh)
+        if new_dp != world_size:
+            raise ValueError(
+                f"the new mesh resolves to data-parallel size {new_dp}, "
+                f"not the requested {world_size} — fix the mesh block's "
+                "axis extents (use -1 to infer from the device count)")
+
+        # ---- swap topology + config, rebuild specs ----
+        self._config = new_config
+        self.mesh = new_mesh
+        self.batch_axes = sharding.batch_axes(new_mesh)
+        self.data_parallel_size = new_dp
+        params_tree = self.state.params
+        self.param_specs = partition.tree_specs(
+            params_tree, self._tp_specs, self.zero_stage, new_mesh, "param")
+        self.master_specs = partition.tree_specs(
+            params_tree, self._tp_specs, self.zero_stage, new_mesh, "master")
+        self.grad_specs = partition.tree_specs(
+            params_tree, self._tp_specs, self.zero_stage, new_mesh, "grad")
+        if self._global_rows() != old_rows:
+            raise RuntimeError(
+                f"elastic re-solve changed the global batch rows "
+                f"({old_rows} -> {self._global_rows()}); the datapipe "
+                "stream would diverge — the elasticity block must pin "
+                "one global batch across its world sizes")
+        if self.canonical_shards and (
+                self.canonical_shards % new_dp != 0):
+            raise RuntimeError(
+                f"elasticity.canonical_shards={self.canonical_shards} is "
+                f"not a multiple of the new data-parallel size {new_dp}; "
+                "bit-identical reduction cannot continue")
+
+        # ---- re-place every device-state leaf onto the new mesh ----
+        def put(tree, specs):
+            return jax.tree.map(
+                lambda x, s: jax.device_put(x, NamedSharding(new_mesh, s)),
+                tree, specs)
+
+        replicated = NamedSharding(new_mesh, P())
+
+        def put_replicated(tree):
+            return jax.tree.map(
+                lambda x: jax.device_put(x, replicated), tree)
+
+        state = self.state
+        new_params = put(state.params, self.param_specs)
+        new_master = (put(state.master, self.master_specs)
+                      if state.master is not None else None)
+        opt_src = new_master if self._use_master else new_params
+        opt_shardings = _opt_state_shardings(
+            self.optimizer, opt_src, new_mesh, self.master_specs)
+        if opt_shardings is not None:
+            new_opt = jax.tree.map(
+                lambda x, s: jax.device_put(x, s),
+                state.opt_state, opt_shardings)
+        else:
+            new_opt = put_replicated(state.opt_state)
+        self.state = EngineState(
+            step=jax.device_put(state.step, replicated),
+            params=new_params,
+            master=new_master,
+            opt_state=new_opt,
+            scaler=put_replicated(state.scaler),
+            skipped=jax.device_put(state.skipped, replicated),
+        )
+
+        # ---- rebuild the reducer; reshard residuals in memory ----
+        if self.comm is not None:
+            from .comm import overlap as comm_overlap
+            from .comm.reducer import GradReducer
+
+            self.comm = GradReducer(
+                new_config.comm_config(), new_mesh,
+                axis_name=self.batch_axes,
+                registry=(self.monitor.registry
+                          if self.monitor is not None else None),
+                canonical=self.canonical_shards)
+            self.comm.build_plan(new_params)
+            self._comm_state = self.comm.init_state()
+            self._comm_acc_reduced = None
+            # same math as the kill-restart load path: fingerprint match
+            # restores directly, a world-size mismatch reshards the
+            # error-feedback residuals onto the new plan
+            self._restore_comm_state(
+                old_comm_host, old_comm_fp, old_comm_plan)
+            self._comm_overlap = (
+                comm_overlap.OverlapScheduler()
+                if comm_overlap.resolve_overlap(
+                    new_config.comm_config(), world=self.comm.world,
+                    canonical=self.canonical_shards)
+                else None)
+
+        # ---- restart data production against the new mesh ----
+        # (drops any staged batches; the cursor is world-agnostic because
+        # the global rows per step are invariant — remap_data_state at
+        # equal rows is the identity)
+        if self.datapipe is not None:
+            self.datapipe.load_state_dict(self.datapipe.state_dict())
+
+        self.tput_timer = ThroughputTimer(
+            batch_size=self.train_micro_batch_size_per_gpu()
+            * self.gradient_accumulation_steps(),
+            num_workers=self.data_parallel_size,
+            steps_per_output=new_config.steps_per_print,
+        )
+        # every compiled entry closed over the old mesh/specs
+        self._compiled = {}
+        # the first step on the new topology recompiles + recommits; skip
+        # one watchdog observation so the warm baseline re-locks
+        self._wd_warmup_left = 1
+
+        if self.monitor is not None:
+            trace_instant("mesh/build", lane="mesh",
+                          axes={k: int(v)
+                                for k, v in dict(new_mesh.shape).items()},
+                          devices=int(new_mesh.devices.size))
+        return new_dp
 
     def save_checkpoint(self, save_dir, tag=None, client_state=None, save_latest=True):
         self._tb_write_pending()

@@ -89,6 +89,10 @@ class ThreadReplica:
         self.restarts = 0
         self.heartbeat_t = float("-inf")
         self.progress = 0
+        # weight-version the engine factory builds; the router pins
+        # failover retries to this so retried requests never mix
+        # token streams from two published versions
+        self.version: Optional[int] = None
         self._thread: Optional[threading.Thread] = None
         self._events: "queue.Queue[dict]" = queue.Queue()
         self._cmds: "queue.Queue[dict]" = queue.Queue()
@@ -154,6 +158,16 @@ class ThreadReplica:
         self.progress = 0
         self.start()
         self.wait_ready()
+
+    def set_weights(self, weights, version: int) -> None:
+        """Stage a weight push; takes effect at the next ``restart()``
+        (the driver thread rebuilds its engine from the factory). For
+        thread replicas ``weights`` is a replacement zero-arg engine
+        factory — in-process fleets share memory, so there is nothing
+        to serialize — or None to bump the version label only."""
+        if weights is not None:
+            self._factory = weights
+        self.version = int(version)
 
     def drain(self, timeout_s: float = 30.0) -> List[str]:
         """Reject new submits, wait for in-flight work to finish.
@@ -260,6 +274,10 @@ class SubprocessReplica:
         self.restarts = 0
         self.heartbeat_t = float("-inf")
         self.progress = 0
+        # published WeightVersion this worker serves (spec-driven so a
+        # restart rebuilds the same engine); router pins retries to it
+        wv = self._spec.get("weights_version")
+        self.version: Optional[int] = int(wv) if wv is not None else None
         # wall-clock skew measured by the post-ready handshake: how far
         # the child's clock runs ahead of ours (seconds); feeds the
         # trace aggregator's --offsets alignment
@@ -363,6 +381,16 @@ class SubprocessReplica:
         self.restarts += 1
         self.progress = 0
         self.start()
+
+    def set_weights(self, weights: Optional[dict], version: int) -> None:
+        """Stage a weight push; takes effect at the next ``restart()``
+        (``start()`` rewrites spec.json from ``self._spec``). ``weights``
+        is the worker's checkpoint pointer — ``{"load_dir", "tag"}`` —
+        or None to bump the version label only."""
+        if weights is not None:
+            self._spec["weights"] = dict(weights)
+        self._spec["weights_version"] = int(version)
+        self.version = int(version)
 
     def drain(self, timeout_s: float = 30.0) -> List[str]:
         self._draining = True

@@ -64,9 +64,32 @@ def _stdin_reader(q: "queue.Queue[Optional[dict]]") -> None:
     q.put(None)   # EOF: parent is gone -> orderly exit
 
 
+def _load_weights(params, weights: dict):
+    """Replace init params with a published checkpoint's module tree.
+
+    ``weights`` is the pointer the lifecycle controller pushes through
+    ``SubprocessReplica.set_weights``: ``{"load_dir", "tag"}`` naming a
+    trainer checkpoint (legacy single-file layout). Every replica pinned
+    to the same WeightVersion loads the same bytes, which is what keeps
+    version-pinned failover retries token-identical."""
+    import os as _os
+
+    from flax import serialization as _ser
+
+    from ..checkpoint.serialization import load_tree, model_state_filename
+
+    path = _os.path.join(str(weights["load_dir"]), str(weights["tag"]),
+                         model_state_filename())
+    model_states = load_tree(path)
+    return _ser.from_state_dict(params, model_states["module"])
+
+
 def build_engine(spec: dict):
     """GPT + ServingEngine from a replica spec: deterministic init from
-    ``init_seed`` so every replica holds the same weights."""
+    ``init_seed`` so every replica holds the same weights. A ``weights``
+    block (``{"load_dir", "tag"}``) swaps in a published checkpoint —
+    same determinism, now anchored to the checkpoint bytes instead of
+    the init PRNG."""
     import jax
     import jax.numpy as jnp
 
@@ -79,6 +102,8 @@ def build_engine(spec: dict):
     cfg = GPTConfig(**gpt_kwargs)
     init_fn, _, _, _ = make_gpt(cfg)
     params = init_fn(jax.random.PRNGKey(int(spec.get("init_seed", 0))))
+    if spec.get("weights"):
+        params = _load_weights(params, spec["weights"])
     scfg = ServingConfig.from_dict(
         {k: v for k, v in (spec.get("serving") or {}).items()
          if k != "fleet"})

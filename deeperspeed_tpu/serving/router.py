@@ -82,6 +82,13 @@ class RouterRequest:
     attempts: int = 0                # dispatches so far
     not_before: float = 0.0          # backoff gate for re-dispatch
     assigned: Optional[str] = None   # replica name, while in flight
+    # weight-version pin: set from the FIRST replica that serves the
+    # request; failover retries only target replicas on the same
+    # version, so the regenerated stream is token-identical. None =
+    # unpinned (versionless replicas, or re-pinned after the version
+    # lost its last replica).
+    version: Optional[int] = None
+    repins: int = 0                  # version pins abandoned (rare)
     first_t: Optional[float] = None
     finish_t: Optional[float] = None
     tokens: Optional[List[int]] = None
@@ -243,6 +250,38 @@ class FleetRouter:
                             replica=st.name):
                 st.replica.restart()
             self._mark_restarted(st)
+
+    def rolling_update(self, version: int, weights: Optional[dict] = None,
+                       timeout_s: float = 120.0) -> None:
+        """Roll the fleet onto a new weight version, one replica at a
+        time. During the transition the fleet is MIXED-version: new
+        requests pin to whichever version first serves them, and
+        failover retries stay inside the pinned version — no request
+        ever sees tokens from two weight sets. ``weights`` is the
+        replica-side load payload (e.g. ``{"load_dir": ..., "tag":
+        ...}``); replicas without a ``set_weights`` method are restarted
+        as-is (version label only)."""
+        for st in self._states:
+            self.drain_replica(st.name, timeout_s)
+            with trace_span("serving/rolling_restart", _TRACE_LANE,
+                            replica=st.name):
+                set_weights = getattr(st.replica, "set_weights", None)
+                if set_weights is not None:
+                    set_weights(weights, version)
+                elif hasattr(st.replica, "version"):
+                    st.replica.version = version
+                st.replica.restart()
+            self._mark_restarted(st)
+            trace_instant("lifecycle/rollout", "lifecycle",
+                          replica=st.name, version=int(version))
+        if self.metrics.registry is not None:
+            self.metrics.registry.counter(
+                "lifecycle_rollout_total",
+                "replica weight-version rollouts completed").inc()
+            self.metrics.registry.gauge(
+                "lifecycle_fleet_version",
+                "newest weight version the fleet was rolled onto",
+            ).set(float(version))
 
     def shutdown(self) -> None:
         for st in self._states:
@@ -406,6 +445,11 @@ class FleetRouter:
             self._finish_local(rec, FINISH_TIMEOUT, now,
                                note="router deadline")
 
+    @staticmethod
+    def _replica_version(st: _ReplicaState) -> Optional[int]:
+        v = getattr(st.replica, "version", None)
+        return int(v) if v is not None else None
+
     def _dispatch(self, now: float) -> None:
         healthy = [st for st in self._states if st.healthy
                    and st.replica.alive]
@@ -420,7 +464,27 @@ class FleetRouter:
             if now < rec.not_before:
                 deferred.append(rid)
                 continue
-            target = min(healthy, key=lambda st: len(st.assigned))
+            pool = healthy
+            if rec.version is not None:
+                pinned = [st for st in healthy
+                          if self._replica_version(st) == rec.version]
+                if pinned:
+                    pool = pinned
+                else:
+                    # the pinned version lost its last healthy replica
+                    # (rollout completed mid-retry): re-pin and
+                    # REGENERATE — every token the client sees comes
+                    # from one weight set, never a spliced stream
+                    rec.repins += 1
+                    trace_instant("lifecycle/repin", "lifecycle",
+                                  rid=rid, version=rec.version)
+                    if self.metrics.registry is not None:
+                        self.metrics.registry.counter(
+                            "lifecycle_repin_total",
+                            "requests re-pinned after their weight "
+                            "version lost its last replica").inc()
+                    rec.version = None
+            target = min(pool, key=lambda st: len(st.assigned))
             try:
                 target.replica.submit(rec.spec)
             except ReplicaUnavailableError:
@@ -430,6 +494,8 @@ class FleetRouter:
                 break
             rec.attempts += 1
             rec.assigned = target.name
+            if rec.version is None:
+                rec.version = self._replica_version(target)
             target.assigned.add(rid)
             # the flow-arrow source: the aggregator pairs this with the
             # replica-side serving/admit carrying the same rid
