@@ -3,7 +3,9 @@
 gather leaks, host callbacks — all read from donation-safe AOT
 lowerings) plus an AST repo-rule linter (mesh construction sites, host
 syncs in traced code, PRNGKey hygiene, trace-event-name registry
-cross-check, undeclared config keys).
+cross-check, undeclared config keys) and a config-provenance check
+(a config claiming autotuned provenance whose tuned knobs were
+hand-edited afterward is an error — see analysis/provenance.py).
 
 CLI: ``python -m deeperspeed_tpu.analysis`` — see ``__main__.py`` and
 ``docs/tutorials/analysis.md``.
@@ -44,6 +46,7 @@ from .hlo import (
     known_rule_axes,
 )
 from .programs import audit_default_programs, default_program_suite
+from .provenance import check_config_provenance
 
 __all__ = [
     "DEFAULT_BASELINE_FILE",
@@ -76,4 +79,5 @@ __all__ = [
     "known_rule_axes",
     "audit_default_programs",
     "default_program_suite",
+    "check_config_provenance",
 ]

@@ -61,6 +61,8 @@ def main(argv=None) -> int:
                    help="skip the compiled-program audit (level 1)")
     p.add_argument("--no-lint", action="store_true",
                    help="skip the AST repo-rule linter (level 2)")
+    p.add_argument("--no-provenance", action="store_true",
+                   help="skip the configs/ provenance check (level 3)")
     p.add_argument("--devices", type=int, default=8,
                    help="virtual CPU device count for the program audit")
     args = p.parse_args(argv)
@@ -87,6 +89,9 @@ def main(argv=None) -> int:
     if not args.no_programs:
         from .programs import audit_default_programs
         findings.extend(audit_default_programs(notes))
+    if not args.no_provenance:
+        from .provenance import check_config_provenance
+        findings.extend(check_config_provenance(root))
 
     sup_path = args.suppressions or os.path.join(root,
                                                  DEFAULT_SUPPRESSIONS_FILE)

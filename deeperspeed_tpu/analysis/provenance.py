@@ -1,0 +1,71 @@
+"""Config-provenance check: "autotuned" must mean autotuned.
+
+Walks the repo's ``configs/*.json`` (plus any explicitly given paths)
+and, for every config that carries a ``"provenance"`` block, re-derives
+the knob fingerprint over the tuned blocks
+(:data:`deeperspeed_tpu.autotune.provenance.TUNED_KEYS`) and compares
+it to the recorded ``knob_hash``. A mismatch — someone hand-edited a
+mesh extent, ZeRO stage, comm knob, kernel route or serving shape after
+the autotuner signed the file — is an **error** finding, so
+``scripts/check.sh`` fails. Configs without a provenance block are
+untouched: hand-rolled configs remain first-class, they just cannot
+*claim* to be autotuned.
+
+Malformed provenance blocks (missing required keys, wrong type) are
+errors too: a half-deleted record is indistinguishable from tampering.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional, Sequence
+
+from ..autotune.provenance import verify_provenance
+from .findings import Finding
+
+__all__ = ["check_config_provenance"]
+
+RULE = "config-provenance"
+
+
+def _config_files(root: str, subdir: str = "configs") -> List[str]:
+    d = os.path.join(root, subdir)
+    if not os.path.isdir(d):
+        return []
+    return sorted(
+        os.path.join(d, f) for f in os.listdir(d) if f.endswith(".json"))
+
+
+def check_config_provenance(
+    root: str,
+    paths: Optional[Sequence[str]] = None,
+) -> List[Finding]:
+    """Findings for every provenance violation under ``root``.
+
+    ``paths`` overrides discovery (absolute or root-relative JSON
+    files); default is every ``configs/*.json``.
+    """
+    files = ([os.path.join(root, p) if not os.path.isabs(p) else p
+              for p in paths]
+             if paths is not None else _config_files(root))
+    out: List[Finding] = []
+    for path in files:
+        rel = os.path.relpath(path, root)
+        try:
+            with open(path) as fh:
+                cfg = json.load(fh)
+        except (OSError, ValueError) as e:
+            out.append(Finding(
+                rule=RULE, severity="error", path=rel, line=0,
+                message=f"unreadable config: {e}"))
+            continue
+        if not isinstance(cfg, dict):
+            continue
+        ok, why = verify_provenance(cfg)
+        if not ok:
+            out.append(Finding(
+                rule=RULE, severity="error", path=rel, line=0,
+                message=why,
+                detail={"provenance": cfg.get("provenance")}))
+    return out

@@ -1,0 +1,68 @@
+"""Watchdog-safe AOT cost capture for candidate entry points.
+
+The autotuner prices candidates by lowering + compiling them ahead of
+time — ``fn.lower(*abstract_avals).compile()`` — which never executes
+the function and never inserts into the jit's dispatch cache
+(``fn._cache_size()`` stays put; only a real call populates it). That
+property is what makes in-process tuning safe: the recompile watchdog
+keys off the same cache counter, so a capture that grew it would fire
+"recompile" alarms inside a healthy training loop.
+
+Two further pollution channels exist beyond the cache, and this module
+closes both:
+
+  * the live :class:`~..monitor.perf.CompiledCostIndex` stamps a
+    ``perf/compiled`` trace instant, refreshes Prometheus gauges, and
+    overwrites the tracer's ``perf`` process-metadata table on every
+    capture — dozens of speculative candidates would bury the real
+    entry points. :func:`sandboxed_cost_index` builds an index with
+    ``registry=None, emit=False``: same capture math, zero side
+    effects on the live monitor/tracer.
+  * a buggy capture path that *called* the candidate (even once) would
+    silently grow its cache. :func:`aot_capture` asserts the cache
+    counter is unchanged across the capture and raises if not — the
+    regression test sweeps 10 candidates against a strict watchdog.
+"""
+
+from typing import Callable, Optional, Tuple
+
+from ..monitor.perf import CompiledCostIndex, CostRecord, _cache_size
+
+__all__ = ["aot_capture", "sandboxed_cost_index"]
+
+
+def sandboxed_cost_index(peaks: Optional[dict] = None) -> CompiledCostIndex:
+    """A CompiledCostIndex that cannot touch the live process.
+
+    No metrics registry (no gauges), ``emit=False`` (no trace instants,
+    no tracer-metadata stamping). Use one per search; throw it away."""
+    return CompiledCostIndex(registry=None, peaks=peaks, emit=False)
+
+
+def aot_capture(
+    name: str,
+    fn: Callable,
+    args: Tuple = (),
+    kwargs: Optional[dict] = None,
+    *,
+    index: Optional[CompiledCostIndex] = None,
+) -> CostRecord:
+    """Capture ``fn``'s compiled cost without executing it.
+
+    Verifies the no-pollution contract: ``fn``'s jit cache size must be
+    identical before and after (AOT lower/compile bypasses the dispatch
+    cache entirely). A change means the capture path executed the
+    candidate — exactly the bug that would trip a live recompile
+    watchdog — so it raises instead of returning a tainted record.
+    """
+    idx = index if index is not None else sandboxed_cost_index()
+    before = _cache_size(fn)
+    rec = idx.observe(name, fn, args, kwargs)
+    after = _cache_size(fn)
+    if before is not None and after != before:
+        raise RuntimeError(
+            f"aot_capture({name!r}) grew the candidate's jit cache "
+            f"({before} -> {after}): the capture executed the function "
+            f"instead of AOT-lowering it; inside a training process this "
+            f"would fire the recompile watchdog")
+    return rec

@@ -178,6 +178,18 @@ METRIC_SPECS: Tuple[MetricSpec, ...] = (
                ("counts", "suppressed"), "lower", 0.0, 1.0,
                note="every new waiver needs a reason in "
                     "ANALYSIS_SUPPRESSIONS.json"),
+    # autotune (PR 16): the cost model's honesty metric is rank
+    # correlation between predicted and measured orderings over the
+    # confirmed set (the acceptance floor is 0.6, so a baseline near
+    # 1.0 minus the absolute band still gates there); the best
+    # predicted cost itself is CPU-nominal and wide-band — it exists
+    # so a cost-model change that doubles every prediction is seen
+    MetricSpec("autotune.rank_correlation", "BENCH_autotune.json",
+               ("confirm", "rank_correlation"), "higher", 0.0, 0.40,
+               note="predicted order must keep tracking measured order"),
+    MetricSpec("autotune.best_predicted_cost", "BENCH_autotune.json",
+               ("best", "predicted_step_s"), "lower", 1.00,
+               note="cpu-nominal roofline seconds: wide band"),
 )
 
 _SPECS_BY_NAME = {s.name: s for s in METRIC_SPECS}

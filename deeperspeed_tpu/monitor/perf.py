@@ -59,17 +59,27 @@ __all__ = [
 
 # peak_tflops: bf16 matmul peak per chip (the basis every MFU number in
 # README/MFU_DECOMP.json uses); peak_gbps: nominal HBM bandwidth, the
-# other roofline axis. Keys are matched as prefixes against the lowered
-# device_kind / PALLAS_AXON_TPU_GEN.
+# other roofline axis; hbm_gib: per-chip capacity (the autotuner's
+# feasibility axis); ici_gbps: nominal per-chip interconnect bandwidth
+# (the wire-model denominator). Keys are matched as prefixes against
+# the lowered device_kind / PALLAS_AXON_TPU_GEN.
 PLATFORM_PEAKS: Dict[str, Dict[str, float]] = {
-    "v4": {"peak_tflops": 275.0, "peak_gbps": 1228.0},
-    "v5p": {"peak_tflops": 459.0, "peak_gbps": 2765.0},
-    "v5e": {"peak_tflops": 197.0, "peak_gbps": 819.0},
-    "v5 lite": {"peak_tflops": 197.0, "peak_gbps": 819.0},
-    "v6e": {"peak_tflops": 918.0, "peak_gbps": 1640.0},
-    "v6 lite": {"peak_tflops": 918.0, "peak_gbps": 1640.0},
+    "v4": {"peak_tflops": 275.0, "peak_gbps": 1228.0,
+           "hbm_gib": 32.0, "ici_gbps": 300.0},
+    "v5p": {"peak_tflops": 459.0, "peak_gbps": 2765.0,
+            "hbm_gib": 95.0, "ici_gbps": 600.0},
+    "v5e": {"peak_tflops": 197.0, "peak_gbps": 819.0,
+            "hbm_gib": 16.0, "ici_gbps": 160.0},
+    "v5 lite": {"peak_tflops": 197.0, "peak_gbps": 819.0,
+                "hbm_gib": 16.0, "ici_gbps": 160.0},
+    "v6e": {"peak_tflops": 918.0, "peak_gbps": 1640.0,
+            "hbm_gib": 32.0, "ici_gbps": 360.0},
+    "v6 lite": {"peak_tflops": 918.0, "peak_gbps": 1640.0,
+                "hbm_gib": 32.0, "ici_gbps": 360.0},
     # nominal: keeps CPU MFU numbers finite and the plumbing testable
-    "cpu": {"peak_tflops": 0.5, "peak_gbps": 50.0},
+    # (1 GiB "HBM" puts the serving pool frontier within CPU-test reach)
+    "cpu": {"peak_tflops": 0.5, "peak_gbps": 50.0,
+            "hbm_gib": 1.0, "ici_gbps": 10.0},
 }
 
 
@@ -242,12 +252,17 @@ class CompiledCostIndex:
     whole table into the tracer's process metadata so a saved trace
     carries its own cost model."""
 
-    def __init__(self, registry=None, peaks: Optional[Dict] = None):
+    def __init__(self, registry=None, peaks: Optional[Dict] = None,
+                 emit: bool = True):
         self._lock = threading.Lock()
         self._records: Dict[str, CostRecord] = {}
         self._registry = registry
         self._peaks = peaks  # lazily resolved: jax may not be up yet
         self._devices: Optional[int] = None
+        # emit=False sandboxes the index (autotune candidate sweeps):
+        # no trace instants, no gauge refresh, no tracer-metadata stamp
+        # — speculative captures must not pollute the live monitor
+        self._emit = bool(emit)
 
     # -- platform ---------------------------------------------------- #
 
@@ -304,10 +319,11 @@ class CompiledCostIndex:
             prev = self._records.get(name)
             rec.captures = (prev.captures if prev else 0) + 1
             self._records[name] = rec
-        if rec.error is None:
+        if rec.error is None and self._emit:
             trace_instant("perf/compiled", lane="perf", **rec.as_args())
             self._export_gauges(rec)
-        self._stamp_metadata()
+        if self._emit:
+            self._stamp_metadata()
         return rec
 
     def _export_gauges(self, rec: CostRecord) -> None:

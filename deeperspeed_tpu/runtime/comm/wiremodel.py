@@ -1,0 +1,106 @@
+"""Modeled wire traffic for a bucket plan — the comm half of the cost model.
+
+The AOT compiled cost (flops / bytes_accessed) prices what each device
+*computes*; this module prices what the reducer puts on the *wire*,
+straight from the same :class:`~.bucketing.BucketPlan` the GradReducer
+executes and the bits-per-element matrix documented in
+:mod:`~.config`:
+
+============  ===============================  ====================
+mode          wire format (two-phase)          ~bits per element
+============  ===============================  ====================
+fp32          fp32 reduce-scatter + all-gather 64
+bf16          bf16 both phases                 32
+int8          blockwise int8 + fp32 scales     16 + 64/block
+compressed    fp16-mantissa + int8 blocks      48
+============  ===============================  ====================
+
+Per-device bytes use the standard ring factor ``2·(w−1)/w`` (one
+reduce-scatter pass plus one all-gather pass, each moving
+``(w−1)/w`` of the payload through every device). Launch counts are
+two collectives per bucket — the term that dominates on small models
+and tiny buckets, which is exactly why the autotuner models it.
+
+Purely arithmetic — no jax — so the tuner can rank comm variants
+without building an engine per variant.
+"""
+
+from typing import Dict, Optional
+
+from .bucketing import BucketPlan
+from .config import MODES, CommConfig
+
+__all__ = [
+    "mode_wire_bits",
+    "plan_collective_launches",
+    "plan_wire_bytes",
+    "ring_factor",
+    "wire_summary",
+]
+
+
+def mode_wire_bits(mode: str, block: int = 128) -> float:
+    """Total bits per gradient element across both collective phases."""
+    if mode not in MODES:
+        raise ValueError(f"unknown comm mode {mode!r}; valid: {list(MODES)}")
+    if mode == "fp32":
+        return 64.0
+    if mode == "bf16":
+        return 32.0
+    if mode == "int8":
+        # int8 payload both phases + one fp32 scale per block per phase
+        return 16.0 + 64.0 / max(1, int(block))
+    return 48.0  # compressed: 24-bit (fp16 mantissa + int8 block exponent)
+
+
+def ring_factor(world: int) -> float:
+    """Fraction of the payload each device moves per phase on a ring."""
+    w = max(1, int(world))
+    return (w - 1) / w
+
+
+def plan_wire_bytes(plan: BucketPlan, cfg: CommConfig, world: int) -> int:
+    """Per-device bytes on the wire for one full reduction of ``plan``."""
+    if world <= 1:
+        return 0
+    bits = mode_wire_bits(cfg.mode, cfg.block)
+    padded = sum(b.padded for b in plan.buckets)
+    return int(padded * bits / 8.0 * 2.0 * ring_factor(world))
+
+
+def plan_collective_launches(plan: BucketPlan, world: int) -> int:
+    """Collective dispatches per reduction: reduce-scatter + all-gather
+    per bucket (the fixed-overhead term tiny buckets multiply)."""
+    if world <= 1:
+        return 0
+    return 2 * len(plan.buckets)
+
+
+def dense_wire_bytes(n_elements: int, world: int,
+                     bits_per_element: float = 64.0) -> int:
+    """The no-reducer baseline: one unbucketed fp32 all-reduce of the
+    whole gradient tree (what ``psum`` costs on the same ring)."""
+    if world <= 1:
+        return 0
+    return int(n_elements * bits_per_element / 8.0 * 2.0 * ring_factor(world))
+
+
+def wire_summary(plan: Optional[BucketPlan], cfg: Optional[CommConfig],
+                 world: int, n_elements: int) -> Dict[str, float]:
+    """One dict the cost model / benches embed: modeled bytes, launches,
+    and the compression ratio vs the dense fp32 baseline."""
+    dense = dense_wire_bytes(n_elements, world)
+    if plan is None or cfg is None:
+        return {
+            "mode": "psum_fp32",
+            "wire_bytes_per_device": float(dense),
+            "collective_launches": 1.0 if world > 1 else 0.0,
+            "vs_dense_fp32": 1.0,
+        }
+    wire = plan_wire_bytes(plan, cfg, world)
+    return {
+        "mode": cfg.mode,
+        "wire_bytes_per_device": float(wire),
+        "collective_launches": float(plan_collective_launches(plan, world)),
+        "vs_dense_fp32": (wire / dense) if dense else 0.0,
+    }

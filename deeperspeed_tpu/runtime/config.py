@@ -499,6 +499,39 @@ class TrainingConfig:
             self.kernels_mode = self.kernels_params.get(
                 c.KERNELS_MODE, c.KERNELS_MODE_DEFAULT)
 
+        # ---- autotune / provenance ----
+        # An "autotune" block records search preferences for
+        # `python -m deeperspeed_tpu.autotune`; a "provenance" block is
+        # what the tuner emitted next to the knobs it chose. Both are
+        # validated eagerly; the knob-hash *integrity* check lives in
+        # the analysis gate (analysis/provenance.py), not here — a
+        # stale signature should fail CI loudly, not block a training
+        # job that deliberately overrode one knob.
+        self.autotune_params = pd.get(c.AUTOTUNE, None)
+        if self.autotune_params is not None and not isinstance(
+                self.autotune_params, dict):
+            raise ConfigError(
+                '"autotune" must be a dict of search preferences '
+                '(or {"enabled": false})')
+        self.autotune_enabled = bool(
+            (self.autotune_params or {}).get(
+                c.AUTOTUNE_ENABLED,
+                self.autotune_params is not None))
+        self.provenance_params = pd.get(c.PROVENANCE, None)
+        if self.provenance_params is not None:
+            from ..autotune.provenance import PROVENANCE_REQUIRED_KEYS
+
+            if not isinstance(self.provenance_params, dict):
+                raise ConfigError(
+                    '"provenance" must be the record emitted by '
+                    'deeperspeed_tpu.autotune (a dict)')
+            missing = [k for k in PROVENANCE_REQUIRED_KEYS
+                       if k not in self.provenance_params]
+            if missing:
+                raise ConfigError(
+                    f'"provenance" record is missing keys {missing} — '
+                    f"re-run the autotuner or drop the block")
+
         bs_sched = pd.get(c.BATCH_SCHEDULER, {})
         if isinstance(bs_sched, dict):
             self.batch_scheduler_enabled = bs_sched.get(

@@ -303,3 +303,19 @@ MESH_ENABLED_DEFAULT = False
 LIFECYCLE = "lifecycle"
 LIFECYCLE_ENABLED = "enabled"
 LIFECYCLE_ENABLED_DEFAULT = False
+
+#############################################
+# Autotune (autotune/ package): an "autotune" block records search
+# preferences a config opts into (quick space, cap, confirm steps) for
+# `python -m deeperspeed_tpu.autotune`; a "provenance" block is the
+# record the tuner EMITS alongside the knobs it chose — search-space
+# hash, knob fingerprint, git_rev, platform, predicted vs measured
+# cost. runtime/config.py validates the shapes eagerly; the analysis
+# gate (analysis/provenance.py) re-derives the knob fingerprint and
+# fails check.sh when a tuned knob was hand-edited after signing.
+#############################################
+AUTOTUNE = "autotune"
+AUTOTUNE_ENABLED = "enabled"
+AUTOTUNE_ENABLED_DEFAULT = False
+
+PROVENANCE = "provenance"

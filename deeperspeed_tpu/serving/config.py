@@ -211,6 +211,14 @@ class ServingConfig:
             f"({self.prefill_buckets[-1]}); raise max_seq_len"
         )
 
+    def kv_pool_bytes(self, n_layer: int, kv_heads: int, head_dim: int,
+                      dtype_bytes: int = 2) -> int:
+        """Bytes the paged KV pool pins in HBM for a given model shape:
+        K and V for every layer, every block — the serving half of the
+        autotuner's HBM-feasibility axis."""
+        per_token = 2 * n_layer * kv_heads * head_dim
+        return self.num_blocks * self.block_size * per_token * dtype_bytes
+
     @classmethod
     def from_dict(cls, d: Optional[dict]) -> "ServingConfig":
         """Build from a ``"serving"`` config block. Unknown keys raise —

@@ -7,7 +7,7 @@ engine/serving/datapipe batch placement all resolve through here. See
 """
 
 from .audit import audit_tree, spec_digest, tree_digest
-from .config import CANONICAL_AXES, MeshConfig
+from .config import CANONICAL_AXES, MeshConfig, resolve_extents
 from .mesh import (DP_AXIS, FSDP_AXIS, SP_AXIS, TP_AXIS, default_mesh,
                    describe, from_config, is_canonical, make_mesh)
 from .rules import (DEFAULT_RULES, add_zero_axis, batch_axes, batch_spec,
@@ -18,7 +18,7 @@ from .rules import (DEFAULT_RULES, add_zero_axis, batch_axes, batch_spec,
                     zero_tree_specs)
 
 __all__ = [
-    "MeshConfig", "CANONICAL_AXES",
+    "MeshConfig", "CANONICAL_AXES", "resolve_extents",
     "DP_AXIS", "FSDP_AXIS", "TP_AXIS", "SP_AXIS",
     "make_mesh", "from_config", "default_mesh", "describe", "is_canonical",
     "DEFAULT_RULES", "resolve_rules", "translate_spec",

@@ -25,7 +25,7 @@ MLP weights replicated on a tp mesh.
 import dataclasses
 from typing import Dict, Optional, Tuple
 
-__all__ = ["MeshConfig", "CANONICAL_AXES"]
+__all__ = ["MeshConfig", "CANONICAL_AXES", "resolve_extents"]
 
 # canonical axis order: batch-ish axes first, the axis with the heaviest
 # steady-state communication (tp, then sp) last so it lands on the
@@ -97,3 +97,35 @@ class MeshConfig:
             out["rules"] = {k: list(v) if isinstance(v, tuple) else v
                             for k, v in self.rules.items()}
         return out
+
+    def resolve(self, world: int) -> Dict[str, int]:
+        """Full extents for ``world`` devices — the same single ``-1``
+        inference ``parallel.topology.build_mesh`` applies, but without
+        needing jax devices, so enumeration/validation tooling (the
+        autotuner's admissibility sweep, config linting) can reason
+        about layouts on any host."""
+        dims = self.axis_dims()
+        inferred = [a for a, v in dims.items() if v == -1]
+        known = 1
+        for v in dims.values():
+            if v != -1:
+                known *= v
+        if inferred:
+            if world % known != 0:
+                raise ValueError(
+                    f"cannot infer mesh axis {inferred[0]!r}: known "
+                    f"extents multiply to {known}, which does not divide "
+                    f"world={world}")
+            dims[inferred[0]] = world // known
+        elif known != world:
+            raise ValueError(
+                f"mesh extents {dims} multiply to {known} != "
+                f"world={world}")
+        return dims
+
+
+def resolve_extents(block: Optional[dict], world: int) -> Dict[str, int]:
+    """Validate a ``"mesh"`` block and resolve it to full canonical
+    extents for ``world`` devices (module-level convenience over
+    :meth:`MeshConfig.resolve`)."""
+    return MeshConfig.from_dict(block).resolve(world)

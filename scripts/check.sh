@@ -24,6 +24,21 @@ for trace in traces/*.json; do
     echo "  $trace OK"
 done
 
+echo "== autotune smoke (quick space, rank-only) =="
+# the config-search pipeline end to end on a small space: enumerate ->
+# AOT-price -> emit + provenance self-check (<60s; measured confirm
+# runs live in scripts/autotune_bench.py, not in the gate)
+JAX_PLATFORMS=cpu python -m deeperspeed_tpu.autotune --devices 8 --quick \
+    --no-confirm --out /tmp/autotune_smoke.json
+python - <<'EOF'
+import json
+from deeperspeed_tpu.autotune.provenance import verify_provenance
+cfg = json.load(open("/tmp/autotune_smoke.json"))
+ok, why = verify_provenance(cfg)
+assert ok, why
+print(f"  emitted config verifies: {why}")
+EOF
+
 echo "== perf ledger =="
 JAX_PLATFORMS=cpu python -m deeperspeed_tpu.monitor.ledger check
 

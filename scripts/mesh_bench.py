@@ -248,13 +248,24 @@ def main():
                           for k in ("final_loss", "step_ms",
                                     "param_sharded_frac")}), flush=True)
 
-    # canonical layouts: (name, mesh block, zero stage, legacy twin)
-    CANONICAL = [
-        ("dp8", {"dp": 8}, 1, 1),
-        ("dp2_fsdp4", {"dp": 2, "fsdp": 4}, 1, 1),
-        ("dp2_fsdp4_zero2", {"dp": 2, "fsdp": 4}, 2, 2),
-        ("fsdp8_zero3", {"fsdp": 8}, 3, 3),
-    ]
+    # canonical layouts come from the autotuner's admissibility
+    # enumerator — the bench measures a slice of the same space
+    # `python -m deeperspeed_tpu.autotune` searches, so the two can
+    # never drift apart. The legacy twin is the layout's ZeRO stage.
+    from deeperspeed_tpu.autotune.space import (ModelSpec,
+                                                enumerate_mesh_layouts)
+    space = {c.name: c for c in enumerate_mesh_layouts(
+        WORLD, ModelSpec(vocab=VOCAB, n_layer=2, n_head=4, d_model=64,
+                         seq=SEQ))}
+    CANONICAL_NAMES = ("dp8", "dp2_fsdp4", "dp2_fsdp4_zero2", "fsdp8_zero3")
+    missing = [n for n in CANONICAL_NAMES if n not in space]
+    if missing:
+        raise SystemExit(
+            f"mesh_bench: canonical layouts {missing} are no longer "
+            f"admitted by autotune.space at world={WORLD} — the bench and "
+            f"the tuner disagree about the space")
+    CANONICAL = [(n, space[n].block(), space[n].zero_stage,
+                  space[n].zero_stage) for n in CANONICAL_NAMES]
     deltas = {}
     for name, block, stage, twin in CANONICAL:
         entry = run_layout(block, stage, args.steps)

@@ -443,6 +443,55 @@ def main():
     else:
         print("  mesh substrate skipped: needs an even multi-device host")
 
+    # autotune on real chips: the CPU CI run prices against nominal
+    # peaks — here the budget comes from the actual platform table
+    # (PALLAS_AXON_TPU_GEN), HBM feasibility is a real constraint, and
+    # the fused kernel route flips from infeasible-on-CPU to preferred
+    if jax.device_count() > 1 and jax.device_count() % 2 == 0:
+        from deeperspeed_tpu.autotune import (
+            ModelSpec, enumerate_mesh_layouts, platform_budget,
+            price_layout, rank_candidates, sandboxed_cost_index)
+        from deeperspeed_tpu.autotune.__main__ import _price_kernel_routes
+        from deeperspeed_tpu.autotune.space import enumerate_kernel_routes
+
+        world = jax.device_count()
+        tune_model = ModelSpec()
+        tune_budget = platform_budget()
+
+        def autotune_price():
+            idx = sandboxed_cost_index()
+            cands = enumerate_mesh_layouts(world, tune_model,
+                                           zero_stages=(1, 3))[:4]
+            prices = [price_layout(c, tune_model, world, tune_budget,
+                                   index=idx)[0] for c in cands]
+            ranked, pruned = rank_candidates(prices)
+            assert ranked, [p.reason for p in pruned]
+            for p in pruned:  # HBM prunes must carry their reason
+                assert p.reason, p.name
+            print(f"    best: {ranked[0].name} "
+                  f"({ranked[0].predicted_step_s * 1e3:.3f} ms modeled on "
+                  f"{tune_budget['source']})")
+            return jnp.zeros(())
+
+        _check(f"autotune AOT pricing ({jax.device_count()} devices)",
+               autotune_price)
+
+        def autotune_kernel_routes():
+            kp = _price_kernel_routes(enumerate_kernel_routes(), 1e-3,
+                                      tune_budget)
+            by_mode = {p.detail["kernels"]["mode"]: p for p in kp}
+            if tune_budget["source"] != "cpu":
+                # on the chip the fused route must be admissible AND
+                # discounted vs 'off'
+                assert by_mode["fused"].feasible
+                assert (by_mode["fused"].predicted_step_s
+                        < by_mode["off"].predicted_step_s)
+            return jnp.zeros(())
+
+        _check("autotune kernel-route pricing", autotune_kernel_routes)
+    else:
+        print("  autotune pricing skipped: needs an even multi-device host")
+
     # static analysis on REAL lowerings: the CPU CI audit proves the
     # programs are clean on a virtual mesh; the alias table, collective
     # layout, and callback set can all differ once Mosaic/XLA-TPU
