@@ -97,8 +97,19 @@ METRIC_SPECS: Tuple[MetricSpec, ...] = (
                ("decode_compiles",), "lower", 0.0,
                note="one-compile decode is the invariant"),
     MetricSpec("serving.prefill_compiles", "BENCH_serving.json",
-               ("prefill_compiles",), "lower", 0.0,
-               note="one compile per length bucket"),
+               ("prefill_compiles",), "lower", 0.0, 2.0,
+               note="one compile per length bucket; --slo warms every "
+                    "bucket (5) where the old bench warmed 3"),
+    # request-path doctor (PR 17): attributed tail latency and unit
+    # cost from the bench's --slo breakdown. Wall-clock on the CPU
+    # host: wide bands; the attribution itself is gated by the slo CLI
+    # in check.sh (residual < 5% is a hard failure there, not here)
+    MetricSpec("serving.ttft_p99_ms", "BENCH_serving.json",
+               ("slo", "ttft_p99_ms"), "lower", 0.50, 25.0,
+               note="cpu wall clock: wide band"),
+    MetricSpec("serving.cost_per_1k_tokens", "BENCH_serving.json",
+               ("slo", "cost_per_1k_tokens"), "lower", 0.50, 0.5,
+               note="device-seconds per 1k tokens, cpu-host nominal"),
     # fleet (PR 8)
     MetricSpec("fleet.fault.accepted", "BENCH_fleet.json",
                ("failover", "fault", "accepted"), "higher", 0.0,

@@ -41,10 +41,14 @@ __all__ = [
     "DEFAULT_STALL_BUCKETS",
 ]
 
-# seconds; spans sub-ms decode steps to multi-second TTFT tails
+# seconds; spans sub-ms decode steps to multi-second TTFT tails. The
+# 0.1–10 s range is deliberately dense: that is where serving TTFT/E2E
+# tails live (cold prefill buckets, HOL blocking, retry backoff), and a
+# p99 estimated from histogram buckets is only as sharp as the bucket
+# walls around it.
 DEFAULT_LATENCY_BUCKETS = (
-    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
-    1.0, 2.5, 5.0, 10.0, 30.0,
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.15, 0.25, 0.35,
+    0.5, 0.75, 1.0, 1.5, 2.5, 3.5, 5.0, 7.5, 10.0, 15.0, 30.0,
 )
 
 # seconds; checkpoint write+commit wall time — tiny CPU-test saves up to

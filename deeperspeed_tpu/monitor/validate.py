@@ -54,6 +54,16 @@ EVENT_ARG_SCHEMAS = {
     "serving/replica_down": ("replica", "cause", "inflight"),
     # run-scoped observability (flight recorder / aggregate / goodput)
     "serving/dispatch": ("rid", "replica", "attempt"),
+    # request-path doctor (monitor/reqledger.py): the per-rid timeline
+    # is reconstructed by joining exactly these events — a dropped rid
+    # or ts breaks attribution, so the schemas are load-bearing
+    "serving/admit": ("rid", "slot", "ctx_len", "admissions"),
+    "serving/prefill": ("rid", "ctx_len"),
+    "serving/preempt": ("rid", "slot", "blocks_freed"),
+    "req/submit": ("rid", "prompt_len"),
+    "req/accept": ("rid", "cost_tokens"),
+    "req/requeue": ("rid", "backoff_s"),
+    "slo/violation": ("slo", "value_ms", "target_ms"),
     "trace/dropped": ("dropped",),
     "flight/recovered": ("count", "torn", "source"),
     "run/start": ("run_id", "role", "incarnation"),
@@ -93,7 +103,7 @@ EVENT_ARG_SCHEMAS = {
 KNOWN_EVENT_PREFIXES = (
     "engine/", "pipe/", "offload/", "comm/", "kernels/", "datapipe/",
     "resilience/", "serving/", "flight/", "run/", "goodput/", "trace/",
-    "perf/", "mem/", "mesh/", "ablation/", "lifecycle/",
+    "perf/", "mem/", "mesh/", "ablation/", "lifecycle/", "req/", "slo/",
 )
 KNOWN_EVENT_NAMES = frozenset({
     "xla_compile", "recompile!", "process_name", "thread_name",

@@ -11,7 +11,7 @@ control, wall-clock deadlines, health-checked failover, and rolling
 restarts. See docs/tutorials/serving.md for the walkthrough.
 """
 
-from .config import RouterConfig, ServingConfig
+from .config import RouterConfig, ServingConfig, SLOConfig
 from .engine import (
     EngineDrainingError,
     PipelineServingBridge,
@@ -28,7 +28,7 @@ from .fleet import (
     build_thread_fleet,
 )
 from .kv_cache import BlockAllocator, PagedKVCache, blocks_needed
-from .metrics import FleetMetrics, ServingMetrics
+from .metrics import FleetMetrics, ServingMetrics, SLOTracker
 from .router import FleetRouter, RouterRequest, ShedError
 from .scheduler import (
     FINISH_EOS,
@@ -44,6 +44,8 @@ from .scheduler import (
 __all__ = [
     "ServingConfig",
     "RouterConfig",
+    "SLOConfig",
+    "SLOTracker",
     "ServingEngine",
     "PipelineServingBridge",
     "EngineDrainingError",

@@ -27,7 +27,11 @@ from typing import Optional, Tuple
 _KNOWN_KEYS = frozenset({
     "enabled", "num_slots", "block_size", "num_blocks", "max_seq_len",
     "max_new_tokens", "eos_token_id", "top_k", "request_timeout_s",
-    "prefill_buckets", "seed", "fleet",
+    "prefill_buckets", "seed", "fleet", "slo",
+})
+
+_SLO_KNOWN_KEYS = frozenset({
+    "ttft_p99_ms", "tpot_p99_ms", "e2e_p99_ms", "error_budget",
 })
 
 _ROUTER_KNOWN_KEYS = frozenset({
@@ -36,6 +40,55 @@ _ROUTER_KNOWN_KEYS = frozenset({
     "retry_backoff_max_s", "heartbeat_timeout_s", "progress_timeout_s",
     "replica_restart", "replica_max_restarts", "poll_interval_s",
 })
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOConfig:
+    """The ``"slo"`` sub-block of the serving config: tail-latency
+    targets the fleet promises its clients. Each target is a p99 bound
+    in milliseconds; None leaves that axis unpromised. Targets drive
+    live burn-rate gauges and ``slo/violation`` trace instants
+    (serving/metrics.SLOTracker) and the offline doctor's verdicts
+    (``python -m deeperspeed_tpu.monitor.slo``).
+
+    ``burn_rate = violating_fraction / error_budget`` — at 1.0 the
+    request stream is violating exactly as fast as a p99 target allows
+    (1% of requests for the default budget); above 1.0 the budget is
+    burning down and the pager should care."""
+
+    ttft_p99_ms: Optional[float] = None   # time to first token
+    tpot_p99_ms: Optional[float] = None   # time per output token
+    e2e_p99_ms: Optional[float] = None    # submit/accept -> terminal
+    error_budget: float = 0.01            # allowed violating fraction
+
+    def __post_init__(self):
+        for key in ("ttft_p99_ms", "tpot_p99_ms", "e2e_p99_ms"):
+            v = getattr(self, key)
+            if v is not None and v <= 0:
+                raise ValueError(f"{key} must be > 0 or None, got {v}")
+        if not 0.0 < self.error_budget < 1.0:
+            raise ValueError(
+                f"error_budget must be in (0, 1), got {self.error_budget}")
+
+    def targets(self) -> dict:
+        """Non-None targets: ``{"ttft": ms, ...}`` keyed by axis."""
+        out = {}
+        for axis in ("ttft", "tpot", "e2e"):
+            v = getattr(self, f"{axis}_p99_ms")
+            if v is not None:
+                out[axis] = float(v)
+        return out
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "SLOConfig":
+        if d is None:
+            return cls()
+        unknown = set(d) - _SLO_KNOWN_KEYS
+        if unknown:
+            raise ValueError(
+                f"unknown slo config keys {sorted(unknown)}; known keys "
+                f"are {sorted(_SLO_KNOWN_KEYS)}")
+        return cls(**d)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -145,11 +198,17 @@ class ServingConfig:
     # multi-replica front-end router policy (serving/router.py); None =
     # single-engine serving, no fleet layer
     fleet: Optional[RouterConfig] = None
+    # tail-latency promises (burn-rate gauges + slo/violation instants);
+    # None = no SLO accounting
+    slo: Optional[SLOConfig] = None
 
     def __post_init__(self):
         if isinstance(self.fleet, dict):
             object.__setattr__(self, "fleet",
                                RouterConfig.from_dict(self.fleet))
+        if isinstance(self.slo, dict):
+            object.__setattr__(self, "slo",
+                               SLOConfig.from_dict(self.slo))
         if self.num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {self.num_slots}")
         if self.block_size < 1:

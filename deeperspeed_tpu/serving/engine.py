@@ -49,7 +49,7 @@ from ..models.generation import apply_with_cache, init_cache, \
     prep_sampling_logits
 from ..models.gpt import GPTConfig, decoder_block, layer_norm
 from ..monitor import get_monitor, init_monitor
-from ..monitor.tracer import trace_counter, trace_span
+from ..monitor.tracer import trace_counter, trace_instant, trace_span
 from ..utils.logging import logger
 from .config import ServingConfig
 from .kv_cache import PagedKVCache, blocks_needed, paged_attend
@@ -206,7 +206,7 @@ class _ServingBase:
         registry = (self.telemetry.registry
                     if self.telemetry is not None else None)
         self.metrics = ServingMetrics(scfg.num_slots, clock, monitor,
-                                      registry)
+                                      registry, slo=scfg.slo)
         self._rid_counter = itertools.count()
         self._requests: Dict[str, Request] = {}
         self._step_i = 0
@@ -253,6 +253,12 @@ class _ServingBase:
         )
         self.sched.submit(req)
         self._requests[rid] = req
+        # the request ledger's clock-zero: every downstream wait bucket
+        # (scheduler queue, HOL blocking, compile, prefill) is measured
+        # against this instant
+        trace_instant("req/submit", lane="serving", rid=rid,
+                      prompt_len=len(prompt),
+                      max_new=req.max_new_tokens)
         return rid
 
     def get(self, rid: str) -> Request:
@@ -515,7 +521,8 @@ class ServingEngine(_ServingBase):
             seeds[s] = req.seed
             counts[s] = len(req.generated)
         with trace_span("serving/decode", lane="serving",
-                        n_active=len(active)) as _sp:
+                        n_active=len(active),
+                        rids=",".join(r.rid for _, r in active)) as _sp:
             _t0 = time.perf_counter()
             timer = self.metrics.timers(DECODE_TIMER)
             timer.safe_start()
@@ -619,7 +626,8 @@ class PipelineServingBridge(_ServingBase):
     def _decode_all(self) -> None:
         active = list(self.sched.active)
         with trace_span("serving/decode", lane="serving",
-                        n_active=len(active)):
+                        n_active=len(active),
+                        rids=",".join(r.rid for r in active)):
             timer = self.metrics.timers(DECODE_TIMER)
             timer.safe_start()
             for req in active:

@@ -14,12 +14,88 @@ from typing import Callable, Dict, List, Optional
 import numpy as np
 
 from ..monitor.metrics import DEFAULT_LATENCY_BUCKETS, MetricsRegistry
+from ..monitor.tracer import trace_instant
 from ..utils.tensorboard import TensorBoardMonitor
 from ..utils.timer import SynchronizedWallClockTimer
 
 # timer names (appear in SynchronizedWallClockTimer.log output)
 PREFILL_TIMER = "serving/prefill"
 DECODE_TIMER = "serving/decode"
+
+
+class SLOTracker:
+    """Live SLO accounting against an ``SLOConfig`` (serving/config.py).
+
+    Each observed latency is checked against its axis target
+    (``ttft``/``tpot``/``e2e`` p99 bounds in ms); a breach emits an
+    ``slo/violation`` trace instant and bumps the labeled violation
+    counter, and every observation refreshes the burn-rate gauge:
+    ``burn_rate = violating_fraction / error_budget``. 1.0 means the
+    stream violates exactly as fast as a p99 promise allows; > 1.0
+    means the error budget is burning down. A None/empty config makes
+    every call a no-op, so both metrics classes embed one
+    unconditionally."""
+
+    def __init__(self, slo=None,
+                 registry: Optional[MetricsRegistry] = None):
+        self.slo = slo
+        self.registry = registry
+        # axis -> [observations, violations]
+        self.counts: Dict[str, List[int]] = {}
+
+    @property
+    def enabled(self) -> bool:
+        return self.slo is not None and bool(self.slo.targets())
+
+    def observe(self, axis: str, seconds: float) -> bool:
+        """Record one latency on ``axis``; returns True on violation."""
+        if self.slo is None:
+            return False
+        target_ms = self.slo.targets().get(axis)
+        if target_ms is None:
+            return False
+        value_ms = seconds * 1e3
+        n = self.counts.setdefault(axis, [0, 0])
+        n[0] += 1
+        violated = value_ms > target_ms
+        if violated:
+            n[1] += 1
+            trace_instant("slo/violation", lane="serving", slo=axis,
+                          value_ms=round(value_ms, 3),
+                          target_ms=target_ms)
+        if self.registry is not None:
+            if violated:
+                self.registry.counter(
+                    "slo_violations_total",
+                    "Latency observations over their SLO target.",
+                    labels={"slo": axis}).inc()
+            self.registry.gauge(
+                "slo_burn_rate",
+                "Violating fraction / error budget (1.0 = burning "
+                "exactly at the p99 promise).",
+                labels={"slo": axis}).set(self.burn_rate(axis))
+        return violated
+
+    def burn_rate(self, axis: str) -> float:
+        n = self.counts.get(axis)
+        if not n or not n[0] or self.slo is None:
+            return 0.0
+        return (n[1] / n[0]) / self.slo.error_budget
+
+    def summary(self) -> Dict[str, Dict]:
+        if self.slo is None:
+            return {}
+        out = {}
+        for axis, target_ms in self.slo.targets().items():
+            obs, viol = self.counts.get(axis, [0, 0])
+            out[axis] = {
+                "target_ms": target_ms,
+                "observations": obs,
+                "violations": viol,
+                "violation_rate": viol / obs if obs else 0.0,
+                "burn_rate": round(self.burn_rate(axis), 4),
+            }
+        return out
 
 
 def record_finish_outcome(registry: Optional[MetricsRegistry],
@@ -55,11 +131,13 @@ class ServingMetrics:
     def __init__(self, num_slots: int,
                  clock: Callable[[], float] = time.monotonic,
                  monitor: Optional[TensorBoardMonitor] = None,
-                 registry: Optional[MetricsRegistry] = None):
+                 registry: Optional[MetricsRegistry] = None,
+                 slo=None):
         self.num_slots = num_slots
         self.clock = clock
         self.monitor = monitor
         self.registry = registry
+        self.slo_tracker = SLOTracker(slo, registry)
         self.timers = SynchronizedWallClockTimer()
         self.ttft_s: List[float] = []
         self.tpot_s: List[float] = []
@@ -113,6 +191,7 @@ class ServingMetrics:
         self.total_generated += 1
         if ttft_s is not None:
             self.ttft_s.append(ttft_s)
+            self.slo_tracker.observe("ttft", ttft_s)
         self._end_t = now
         if self.registry is not None:
             self._c_prefills.inc()
@@ -150,6 +229,11 @@ class ServingMetrics:
         if n > 1 and req.first_token_t is not None:
             tpot = (now - req.first_token_t) / (n - 1)
             self.tpot_s.append(tpot)
+            self.slo_tracker.observe("tpot", tpot)
+        if req.first_token_t is not None:
+            # engine-side E2E: arrival to terminal (the router tracks
+            # its own accept-to-terminal E2E for fleet serving)
+            self.slo_tracker.observe("e2e", now - req.arrival_t)
         if self.registry is not None:
             self.registry.counter(
                 "serving_requests_finished_total",
@@ -189,6 +273,7 @@ class ServingMetrics:
             "tpot_s": _percentiles(self.tpot_s),
             "slot_occupancy": float(occ.mean()) if occ.size else 0.0,
             "queue_depth_max": int(max(self.queue_depth, default=0)),
+            "slo": self.slo_tracker.summary(),
         }
 
     def export(self, step: int) -> None:
@@ -225,9 +310,11 @@ class FleetMetrics:
     """
 
     def __init__(self, clock: Callable[[], float] = time.monotonic,
-                 registry: Optional[MetricsRegistry] = None):
+                 registry: Optional[MetricsRegistry] = None,
+                 slo=None):
         self.clock = clock
         self.registry = registry
+        self.slo_tracker = SLOTracker(slo, registry)
         self.accepted = 0
         self.shed = 0
         self.retries = 0
@@ -287,6 +374,7 @@ class FleetMetrics:
 
     def record_ttft(self, ttft: float) -> None:
         self.ttft_s.append(ttft)
+        self.slo_tracker.observe("ttft", ttft)
         if self.registry is not None:
             self._h_ttft.observe(ttft)
 
@@ -298,6 +386,7 @@ class FleetMetrics:
         self.outcomes[reason] = self.outcomes.get(reason, 0) + 1
         if e2e_s is not None:
             self.e2e_s.append(e2e_s)
+            self.slo_tracker.observe("e2e", e2e_s)
             if self.registry is not None:
                 self._h_e2e.observe(e2e_s)
         record_finish_outcome(self.registry, reason)
@@ -341,4 +430,5 @@ class FleetMetrics:
             "outcomes": dict(self.outcomes),
             "router_ttft_s": _percentiles(self.ttft_s),
             "router_e2e_s": _percentiles(self.e2e_s),
+            "slo": self.slo_tracker.summary(),
         }

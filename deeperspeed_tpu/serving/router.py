@@ -121,7 +121,7 @@ class FleetRouter:
     def __init__(self, replicas: Sequence[object],
                  rcfg: Optional[RouterConfig] = None,
                  clock: Callable[[], float] = time.monotonic,
-                 registry=None, base_seed: int = 0):
+                 registry=None, base_seed: int = 0, slo=None):
         if not replicas:
             raise ValueError("FleetRouter needs at least one replica")
         self.rcfg = rcfg or RouterConfig()
@@ -136,7 +136,10 @@ class FleetRouter:
         if registry is None:
             mon = get_monitor()
             registry = mon.registry if mon is not None else None
-        self.metrics = FleetMetrics(clock=clock, registry=registry)
+        # slo: an SLOConfig (serving/config.py) — router-observed TTFT
+        # and E2E latencies feed its burn-rate gauges
+        self.metrics = FleetMetrics(clock=clock, registry=registry,
+                                    slo=slo)
 
     # -- client surface ----------------------------------------------
 
@@ -177,6 +180,10 @@ class FleetRouter:
         self._pending.append(rid)
         self._inflight_tokens += cost
         self.metrics.record_accept()
+        # router-side clock-zero for the request ledger (the engine-side
+        # counterpart is req/submit, emitted at replica admission)
+        trace_instant("req/accept", _TRACE_LANE, rid=rid,
+                      cost_tokens=cost)
         return rid
 
     def result(self, rid: str) -> RouterRequest:
@@ -415,12 +422,13 @@ class FleetRouter:
         """Put an in-flight request back on the dispatch queue after its
         replica failed (penalize=True, charges the retry budget and
         backs off) or drained (penalize=False, immediate)."""
+        now = self.clock()
         if penalize and rec.attempts > self.rcfg.retry_max:
-            self._finish_local(rec, FINISH_FAILED, self.clock(),
+            self._finish_local(rec, FINISH_FAILED, now,
                                note="retry budget exhausted")
             return
         if penalize:
-            rec.not_before = self.clock() + compute_backoff(
+            rec.not_before = now + compute_backoff(
                 max(1, rec.attempts), self.rcfg.retry_backoff_base_s,
                 2.0, self.rcfg.retry_backoff_max_s)
         else:
@@ -428,6 +436,11 @@ class FleetRouter:
         rec.assigned = None
         if rec.rid not in self._pending:
             self._pending.appendleft(rec.rid)
+        # the ledger's retry-backoff bucket: [this instant -> the rid's
+        # next serving/dispatch] is time the request sat out on purpose
+        trace_instant("req/requeue", _TRACE_LANE, rid=rec.rid,
+                      backoff_s=round(max(0.0, rec.not_before - now), 6),
+                      penalize=bool(penalize))
 
     def _enforce_deadlines(self, now: float) -> None:
         for rec in self._reqs.values():

@@ -65,6 +65,11 @@ class Request:
     slot: int = -1
     cached_len: int = 0           # tokens whose KV is written to the pool
     admissions: int = 0           # 1 + number of preemption re-admissions
+    # cost-ledger accounting: integral of (blocks held × seconds held),
+    # accrued at every block-count change point while the request holds
+    # a slot — the per-request share of the paged pool
+    kv_block_s: float = 0.0
+    kv_accrue_t: Optional[float] = None
     first_token_t: Optional[float] = None
     last_token_t: Optional[float] = None   # progress clock for timeouts
     finish_t: Optional[float] = None
@@ -170,6 +175,7 @@ class Scheduler:
         req.slot = slot
         req.cached_len = len(req.context)
         req.admissions += 1
+        req.kv_accrue_t = self.clock()
         self.slots[slot] = req
         self.slot_blocks[slot] = blocks
         self._slot_admitted_at[slot] = next(self._admit_seq)
@@ -199,6 +205,7 @@ class Scheduler:
                     break
                 extra = self.allocator.alloc(short)
                 if extra is not None:
+                    self._accrue_kv(slot)
                     self.slot_blocks[slot].extend(extra)
                     break
                 victim = self._preempt_victim()
@@ -221,6 +228,8 @@ class Scheduler:
         )
         trace_instant("serving/preempt", lane="serving", rid=req.rid,
                       slot=slot, blocks_freed=len(self.slot_blocks[slot]))
+        self._accrue_kv(slot)
+        req.kv_accrue_t = None
         self._release_slot(slot)
         req.state = QUEUED
         req.slot = -1
@@ -238,9 +247,23 @@ class Scheduler:
         self.slots[slot] = None
         self._slot_admitted_at[slot] = -1
 
+    def _accrue_kv(self, slot: int) -> None:
+        """Charge the slot's request for the blocks it held since the
+        last change point (admission, block growth, preemption, finish).
+        Block-seconds, not blocks: the cost ledger's KV-occupancy axis."""
+        req = self.slots[slot]
+        if req is None or req.kv_accrue_t is None:
+            return
+        now = self.clock()
+        req.kv_block_s += ((now - req.kv_accrue_t)
+                           * len(self.slot_blocks[slot]))
+        req.kv_accrue_t = now
+
     def finish(self, req: Request, reason: str,
                now: Optional[float] = None) -> None:
         if req.state == ACTIVE:
+            self._accrue_kv(req.slot)
+            req.kv_accrue_t = None
             self._release_slot(req.slot)
         elif req.state == QUEUED:
             self.queue.remove(req)
@@ -250,7 +273,9 @@ class Scheduler:
         req.finish_t = self.clock() if now is None else now
         self.finished.append(req)
         trace_instant("serving/finish", lane="serving", rid=req.rid,
-                      reason=reason, tokens=len(req.generated))
+                      reason=reason, tokens=len(req.generated),
+                      admissions=req.admissions,
+                      kv_block_s=round(req.kv_block_s, 6))
 
     def check_finished(self, req: Request,
                        now: Optional[float] = None) -> bool:

@@ -24,6 +24,16 @@ for trace in traces/*.json; do
     echo "  $trace OK"
 done
 
+echo "== request-path doctor (tail-latency attribution gate) =="
+# the doctor must be able to explain >= 95% of every request's TTFT on
+# the committed drill traces — if attribution stops covering the tail,
+# the build fails, not the postmortem
+for trace in traces/serving_bench_trace.json traces/obs_drill_merged.json; do
+    [ -e "$trace" ] || continue
+    JAX_PLATFORMS=cpu python -m deeperspeed_tpu.monitor.slo \
+        --max-residual 0.05 "$trace"
+done
+
 echo "== autotune smoke (quick space, rank-only) =="
 # the config-search pipeline end to end on a small space: enumerate ->
 # AOT-price -> emit + provenance self-check (<60s; measured confirm

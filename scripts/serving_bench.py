@@ -33,8 +33,10 @@ import numpy as np  # noqa: E402
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--requests", type=int, default=32)
-    ap.add_argument("--rate", type=float, default=8.0,
-                    help="Poisson arrival rate, requests/s")
+    ap.add_argument("--rate", type=float, default=None,
+                    help="Poisson arrival rate, requests/s (default 8; "
+                         "80 in --slo mode, where the doctor needs real "
+                         "admission contention to attribute)")
     ap.add_argument("--num-slots", type=int, default=4)
     ap.add_argument("--block-size", type=int, default=16)
     ap.add_argument("--num-blocks", type=int, default=64)
@@ -53,7 +55,19 @@ def main():
     ap.add_argument("--metrics-port", type=int, default=None,
                     help="serve Prometheus /metrics on this port for the "
                          "duration of the run (0 = ephemeral)")
+    ap.add_argument("--slo", action="store_true",
+                    help="request-path-doctor mode: set SLO targets, warm "
+                         "EVERY prefill bucket (so measured requests pay "
+                         "no compile), skew the prompt mix long-tailed, "
+                         "and emit an attribution breakdown ('slo' block) "
+                         "from the trace via monitor/reqledger")
     args = ap.parse_args()
+    if args.rate is None:
+        args.rate = 80.0 if args.slo else 8.0
+    if args.slo and args.trace is None:
+        # attribution needs the trace; default it next to the other
+        # committed drill traces
+        args.trace = os.path.join("traces", "serving_bench_trace.json")
 
     from deeperspeed_tpu.models.gpt import GPTConfig, make_gpt
     from deeperspeed_tpu.serving import ServingConfig, ServingEngine
@@ -66,7 +80,10 @@ def main():
     scfg = ServingConfig(num_slots=args.num_slots,
                          block_size=args.block_size,
                          num_blocks=args.num_blocks,
-                         max_seq_len=args.max_seq_len)
+                         max_seq_len=args.max_seq_len,
+                         slo=({"ttft_p99_ms": 250.0, "tpot_p99_ms": 50.0,
+                               "e2e_p99_ms": 2500.0}
+                              if args.slo else None))
     monitor_config = None
     if args.trace is not None or args.metrics_port is not None:
         monitor_config = {
@@ -84,17 +101,39 @@ def main():
     plens = rng.integers(args.prompt_len[0], args.prompt_len[1] + 1,
                          args.requests)
     news = rng.integers(args.max_new[0], args.max_new[1] + 1, args.requests)
+    if args.slo:
+        # heavy-tailed prompt mix, short generations: half the traffic
+        # carries near-max-bucket prompts and every request finishes in
+        # a few decode steps, so slots churn through admission waves of
+        # expensive prefills — the TTFT tail is genuine head-of-line
+        # blocking behind long prefills (the thing the doctor
+        # attributes), not compile noise or decode occupancy
+        long_mask = rng.random(args.requests) < 0.5
+        plens = np.where(long_mask,
+                         rng.integers(160, 221, args.requests),
+                         rng.integers(32, 97, args.requests))
+        news = rng.integers(4, 9, args.requests)
     prompts = [rng.integers(0, cfg.vocab_size, p).tolist() for p in plens]
 
     # warm the compiled paths so the measured run is steady-state (one
-    # decode program + the prefill buckets the trace will hit)
-    warm = eng.submit(prompts[0], max_new_tokens=2)
-    eng.run()
-    assert eng.get(warm).state == "finished"
+    # decode program + the prefill buckets the trace will hit); doctor
+    # mode warms EVERY bucket — measured requests must pay zero compile,
+    # so the tail the doctor reads is scheduling, not XLA
+    if args.slo:
+        for b in scfg.prefill_buckets:
+            eng.submit(rng.integers(0, cfg.vocab_size,
+                                    max(1, b - 2)).tolist(),
+                       max_new_tokens=2, request_id=f"warm-{b}")
+        eng.run()
+        assert all(r.state == "finished" for r in eng.sched.finished)
+    else:
+        warm = eng.submit(prompts[0], max_new_tokens=2)
+        eng.run()
+        assert eng.get(warm).state == "finished"
     # drop warmup stats (Prometheus counters, being cumulative, keep the
     # warmup request — the trace marks the measured-run boundary instead)
     eng.metrics.__init__(scfg.num_slots, eng.clock,
-                         registry=eng.metrics.registry)
+                         registry=eng.metrics.registry, slo=scfg.slo)
 
     t0 = time.monotonic()
     submitted = 0
@@ -148,6 +187,24 @@ def main():
         if args.trace is not None:
             errors = validate_file(args.trace)
             assert not errors, errors[:5]
+    if args.slo:
+        # offline attribution over the trace just written: where every
+        # request's TTFT went, who blocked whom, and what a kilotoken
+        # costs — the keys PERF_LEDGER gates (serving.ttft_p99_ms,
+        # serving.cost_per_1k_tokens)
+        from deeperspeed_tpu.monitor.reqledger import build_ledger
+
+        report = build_ledger(args.trace)
+        out["slo"] = {
+            "targets": s["slo"],
+            "ttft_p99_ms": report["ttft"]["p99_ms"],
+            "e2e_p99_ms": report["e2e"]["p99_ms"],
+            "cost_per_1k_tokens": report["cost_per_1k_tokens"],
+            "buckets_total_ms": report["buckets_total_ms"],
+            "p99_victim": report["p99_victim"],
+            "top_blockers": report["top_blockers"],
+            "worst_residual_fraction": report["worst_residual_fraction"],
+        }
     with open(args.out, "w") as f:
         json.dump(out, f, indent=2)
         f.write("\n")

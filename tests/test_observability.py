@@ -319,7 +319,8 @@ def _replica_flight(tmp_path, monkeypatch):
     monkeypatch.setenv(ROLE_ENV, "replica-r0")
     fl = FlightRecorder(str(tmp_path / "replica-r0.i0.flight.bin"),
                         capacity=32, slot_bytes=256)
-    fl.append(_ev("serving/admit", time.perf_counter() * 1e6, rid="q1"))
+    fl.append(_ev("serving/admit", time.perf_counter() * 1e6, rid="q1",
+                  slot=0, ctx_len=8, admissions=1))
     fl.append(_span("serving/decode", time.perf_counter() * 1e6, 1000,
                     rid="q1"))
     fl.close()
