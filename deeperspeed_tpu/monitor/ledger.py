@@ -105,11 +105,24 @@ METRIC_SPECS: Tuple[MetricSpec, ...] = (
     # host: wide bands; the attribution itself is gated by the slo CLI
     # in check.sh (residual < 5% is a hard failure there, not here)
     MetricSpec("serving.ttft_p99_ms", "BENCH_serving.json",
-               ("slo", "ttft_p99_ms"), "lower", 0.50, 25.0,
-               note="cpu wall clock: wide band"),
+               ("slo", "ttft_p99_ms"), "lower", 0.50, 85.0,
+               note="cpu wall clock: wide band; basis changed at the "
+                    "--shared-prefix bench (slo pass now measures a "
+                    "d_model=256 model, was 64) — abs band covers the "
+                    "declared re-basis until the rolling median "
+                    "catches up"),
     MetricSpec("serving.cost_per_1k_tokens", "BENCH_serving.json",
                ("slo", "cost_per_1k_tokens"), "lower", 0.50, 0.5,
                note="device-seconds per 1k tokens, cpu-host nominal"),
+    # prefix-radix KV reuse (PR 18): the --shared-prefix traffic mix
+    # must keep finding its system prompts in the radix cache — a
+    # regression here means prompts are being re-prefilled fleet-wide
+    MetricSpec("serving.prefill_tokens_saved_frac", "BENCH_serving.json",
+               ("prefix_reuse", "tokens_saved_frac"), "higher", 0.15,
+               note="fraction of prompt tokens served from the radix "
+                    "cache under --shared-prefix traffic"),
+    MetricSpec("serving.reuse_hit_rate", "BENCH_serving.json",
+               ("prefix_reuse", "reuse_hit_rate"), "higher", 0.15),
     # fleet (PR 8)
     MetricSpec("fleet.fault.accepted", "BENCH_fleet.json",
                ("failover", "fault", "accepted"), "higher", 0.0,

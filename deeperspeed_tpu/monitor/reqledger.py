@@ -21,8 +21,11 @@ dropped):
   ``compile``           ``xla_compile`` inside the window — split
                         out of the rid's own prefill first, then
                         whatever else fires on its serving process
-  ``prefill``           the rid's own ``serving/prefill`` spans,
-                        compile time removed
+  ``prefill``           the rid's own ``serving/prefill`` +
+                        ``serving/prefill_chunk`` spans, compile time
+                        removed (chunked prefill is own prefill,
+                        spread across steps; other rids' chunks land
+                        in ``hol_blocking`` like any other prefill)
   ``retry_backoff``     ``req/requeue`` -> next dispatch (failover
                         penalty holds + shed retry-after)
   ``router_queue``      ``req/accept`` -> first dispatch (admission
@@ -142,8 +145,15 @@ class RequestTimeline:
         dataclasses.field(default_factory=list)      # (ts, pid)
     preempts: List[Tuple[float, object]] = \
         dataclasses.field(default_factory=list)      # (ts, pid)
-    # (start, end, pid, ctx_len) own prefill spans
+    # (start, end, pid, ctx_len) own prefill spans. With chunked
+    # prefill the engine emits one ``serving/prefill`` span only for the
+    # FINAL chunk (the one that emits token 0), so first_token_ts and
+    # the one-token-per-prefill-span cost invariant survive chunking
     prefills: List[Tuple[float, float, object, int]] = \
+        dataclasses.field(default_factory=list)
+    # (start, end, pid, tokens) own non-final ``serving/prefill_chunk``
+    # spans — the rid's own prefill work, spread over engine steps
+    chunks: List[Tuple[float, float, object, int]] = \
         dataclasses.field(default_factory=list)
     # (start, end, pid, n_active) decode spans the rid rode in
     decodes: List[Tuple[float, float, object, int]] = \
@@ -188,6 +198,7 @@ class RequestTimeline:
         can be charged to this request."""
         pids = {p for _ts, p in self.admits}
         pids.update(p for _s, _e, p, _c in self.prefills)
+        pids.update(p for _s, _e, p, _c in self.chunks)
         return sorted(pids, key=repr)
 
     def ttft_window(self) -> Optional[Interval]:
@@ -263,6 +274,15 @@ def build_index(events: List[dict]) -> TraceIndex:
                     (start, end, pid, int(args.get("ctx_len", 0))))
                 prefills_by_pid.setdefault(pid, []).append(
                     (start, end, str(rid)))
+            elif name == "serving/prefill_chunk" and rid is not None:
+                # a chunk forward is the rid's OWN prefill work and,
+                # symmetrically, head-of-line blocking for everyone
+                # else on the same track — so it joins the per-pid
+                # prefill pool HOL attribution draws from
+                tl(rid).chunks.append(
+                    (start, end, pid, int(args.get("tokens", 0))))
+                prefills_by_pid.setdefault(pid, []).append(
+                    (start, end, str(rid)))
             elif name == "serving/decode":
                 decodes_by_pid.setdefault(pid, []).append((start, end))
                 riders = [r for r in
@@ -303,6 +323,7 @@ def build_index(events: List[dict]) -> TraceIndex:
     for tline in tls.values():
         tline.dispatches.sort()
         tline.prefills.sort()
+        tline.chunks.sort()
         tline.decodes.sort()
         tline.finishes.sort()
     rollouts.sort()
@@ -342,7 +363,12 @@ def attribute_window(idx: TraceIndex, tline: RequestTimeline,
     """
     pids = _serving_pids(idx, tline)
 
-    own_prefill = _clip([(s, e) for s, e, _p, _c in tline.prefills],
+    # own prefill = the final-chunk serving/prefill span(s) plus any
+    # earlier serving/prefill_chunk spans: chunked prefill is still the
+    # rid's own prefill time, just spread across engine steps instead
+    # of one contiguous stall
+    own_prefill = _clip([(s, e) for s, e, _p, _c in tline.prefills]
+                        + [(s, e) for s, e, _p, _c in tline.chunks],
                         window)
     compile_all = _clip(
         [iv for p in pids for iv in idx.compiles_by_pid.get(p, [])],
@@ -479,6 +505,11 @@ def request_cost(idx: TraceIndex, tline: RequestTimeline) -> dict:
         tokens[a] += 1
         prefill_ctx[a] += ctx
         device_us[a] += end - _s
+    for s, e, _pid, _tok in tline.chunks:
+        # non-final chunks consume device time but emit no token (the
+        # final chunk's serving/prefill span carries that), and their
+        # context tokens are already inside the final span's ctx_len
+        device_us[attempt_of(e)] += e - s
     for s, e, _pid, n in tline.decodes:
         a = attempt_of(e)
         tokens[a] += 1

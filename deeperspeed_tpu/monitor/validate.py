@@ -60,6 +60,13 @@ EVENT_ARG_SCHEMAS = {
     "serving/admit": ("rid", "slot", "ctx_len", "admissions"),
     "serving/prefill": ("rid", "ctx_len"),
     "serving/preempt": ("rid", "slot", "blocks_freed"),
+    # prefix-radix KV reuse + chunked prefill: reuse hits are the
+    # aggregator's flow-arrow source per rid, CoW splits audit the
+    # exactly-once divergence invariant, and chunk spans are what the
+    # reqledger splits across its prefill/hol_blocking buckets
+    "kv/reuse": ("rid", "matched_tokens", "shared_blocks"),
+    "kv/cow_split": ("rid", "block", "rows"),
+    "serving/prefill_chunk": ("rid", "chunk", "tokens"),
     "req/submit": ("rid", "prompt_len"),
     "req/accept": ("rid", "cost_tokens"),
     "req/requeue": ("rid", "backoff_s"),
@@ -104,6 +111,7 @@ KNOWN_EVENT_PREFIXES = (
     "engine/", "pipe/", "offload/", "comm/", "kernels/", "datapipe/",
     "resilience/", "serving/", "flight/", "run/", "goodput/", "trace/",
     "perf/", "mem/", "mesh/", "ablation/", "lifecycle/", "req/", "slo/",
+    "kv/",
 )
 KNOWN_EVENT_NAMES = frozenset({
     "xla_compile", "recompile!", "process_name", "thread_name",

@@ -1529,6 +1529,14 @@ class Engine(ConfigAccessorsMixin):
                                 verdict=_stats["verdict"])
             if mw is not None:
                 mw.annotate(_tb_sp, "train_batch")
+        if self._layer_collector is not None:
+            # jax.debug.callback taps inside the layer scan are silently
+            # dropped once the scan is linearized under grad, so the
+            # train step itself can never surface them; replay the same
+            # (packed) batch and rng through the forward-only program,
+            # where the taps do fire — forward hooks observe forward
+            # activations, matching the reference semantics
+            self._forward_only_fn()(self.state, batch, rng)
         if wd is not None:
             # the train step must compile once (after sharding commits,
             # see __init__) and stay compiled; cache growth past the warm

@@ -28,6 +28,7 @@ _KNOWN_KEYS = frozenset({
     "enabled", "num_slots", "block_size", "num_blocks", "max_seq_len",
     "max_new_tokens", "eos_token_id", "top_k", "request_timeout_s",
     "prefill_buckets", "seed", "fleet", "slo",
+    "prefix_caching", "prefill_chunk", "prefill_token_budget",
 })
 
 _SLO_KNOWN_KEYS = frozenset({
@@ -39,6 +40,7 @@ _ROUTER_KNOWN_KEYS = frozenset({
     "default_deadline_s", "retry_max", "retry_backoff_base_s",
     "retry_backoff_max_s", "heartbeat_timeout_s", "progress_timeout_s",
     "replica_restart", "replica_max_restarts", "poll_interval_s",
+    "prefix_affinity", "affinity_prefix_len", "affinity_load_slack",
 })
 
 
@@ -127,8 +129,24 @@ class RouterConfig:
     replica_max_restarts: int = 2
     # router run()/drive loop sleep when idle
     poll_interval_s: float = 0.01
+    # prefix affinity: hash each request's first affinity_prefix_len
+    # prompt tokens and prefer the replica that last served that prefix
+    # (its radix cache is warm), as long as that replica's assigned
+    # count is within affinity_load_slack of the least-loaded one —
+    # affinity never overrides health, and never builds hot spots
+    prefix_affinity: bool = False
+    affinity_prefix_len: int = 64
+    affinity_load_slack: int = 2
 
     def __post_init__(self):
+        if self.affinity_prefix_len < 1:
+            raise ValueError(
+                f"affinity_prefix_len must be >= 1, got "
+                f"{self.affinity_prefix_len}")
+        if self.affinity_load_slack < 0:
+            raise ValueError(
+                f"affinity_load_slack must be >= 0, got "
+                f"{self.affinity_load_slack}")
         if self.num_replicas < 1:
             raise ValueError(
                 f"num_replicas must be >= 1, got {self.num_replicas}")
@@ -195,6 +213,21 @@ class ServingConfig:
     prefill_buckets: Tuple[int, ...] = ()
     # base PRNG seed for sampled slots (per-request seeds derive from it)
     seed: int = 0
+    # prefix-radix KV reuse: index prefilled prompts in a radix trie and
+    # admit new requests by longest cached prefix, mapping shared blocks
+    # read-only and prefilling only the suffix. Off by default — the
+    # exact-ownership block accounting stays bit-for-bit what it was.
+    prefix_caching: bool = False
+    # chunked prefill: prompts longer than this prefill in fixed-size
+    # chunks interleaved with decode steps (one extra compile per
+    # (chunk, cache-bucket) pair; the decode jit never retraces). None
+    # disables chunking (one-shot prefill, the original behavior).
+    prefill_chunk: Optional[int] = None
+    # per-step prefill token budget: one scheduler step runs at most
+    # this many prefill tokens (admissions + chunks) before decoding,
+    # so a wave of long prompts cannot stall active decodes for more
+    # than ~budget tokens of prefill compute. None = unbounded.
+    prefill_token_budget: Optional[int] = None
     # multi-replica front-end router policy (serving/router.py); None =
     # single-engine serving, no fleet layer
     fleet: Optional[RouterConfig] = None
@@ -241,6 +274,15 @@ class ServingConfig:
                 f"max_seq_len ({self.max_seq_len})"
             )
         object.__setattr__(self, "prefill_buckets", buckets)
+        if self.prefill_chunk is not None and self.prefill_chunk < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1 or None, got "
+                f"{self.prefill_chunk}")
+        if (self.prefill_token_budget is not None
+                and self.prefill_token_budget < 1):
+            raise ValueError(
+                f"prefill_token_budget must be >= 1 or None, got "
+                f"{self.prefill_token_budget}")
 
     def _default_buckets(self):
         buckets, b = [], self.block_size
@@ -269,6 +311,34 @@ class ServingConfig:
             f"prompt length {length} exceeds the largest prefill bucket "
             f"({self.prefill_buckets[-1]}); raise max_seq_len"
         )
+
+    def prefill_plan(self, ctx_len: int,
+                     matched: int = 0) -> Optional[Tuple[int, int, int]]:
+        """Shape plan for a (possibly suffix-only, possibly chunked)
+        staging-cache prefill of ``ctx_len`` context tokens of which
+        ``matched`` are already cached: ``(n_chunks, chunk_tokens,
+        cache_len)``. The forward runs n_chunks times over
+        (1, chunk_tokens) token slabs against a (1, cache_len) staging
+        cache at a TRACED offset, so compiles are bounded by
+        (chunk size, cache bucket) pairs, never by matched/offset values.
+        None when no bucket combination covers the request — the caller
+        falls back to the one-shot full prefill (correct, just unshared).
+        """
+        suffix = ctx_len - matched
+        if suffix < 1:
+            return None
+        try:
+            if (self.prefill_chunk is not None
+                    and suffix > self.prefill_chunk):
+                chunk = self.prefill_chunk
+                n = math.ceil(suffix / chunk)
+                return n, chunk, self.bucket_for(matched + n * chunk)
+            s_pad = self.bucket_for(suffix)
+            cache_len = (self.bucket_for(matched + s_pad) if matched
+                         else s_pad)
+            return 1, s_pad, cache_len
+        except ValueError:
+            return None
 
     def kv_pool_bytes(self, n_layer: int, kv_heads: int, head_dim: int,
                       dtype_bytes: int = 2) -> int:

@@ -52,7 +52,7 @@ from ..monitor import get_monitor, init_monitor
 from ..monitor.tracer import trace_counter, trace_instant, trace_span
 from ..utils.logging import logger
 from .config import ServingConfig
-from .kv_cache import PagedKVCache, blocks_needed, paged_attend
+from .kv_cache import NULL_BLOCK, PagedKVCache, blocks_needed, paged_attend
 from .metrics import DECODE_TIMER, PREFILL_TIMER, ServingMetrics
 from .scheduler import Request, Scheduler
 
@@ -288,16 +288,14 @@ class _ServingBase:
             now = self.clock()
             for req in self.sched.expire_timeouts(now):
                 self.metrics.record_finish(req, now)
-            if not self._draining:
-                while (adm := self.sched.pop_admissible()) is not None:
-                    self._admit_one(*adm)
+            self._prefill_phase()
             for _ in self.sched.ensure_decode_capacity():
                 self.metrics.record_preemption()
             trace_counter("serving/load", {
                 "queued": len(self.sched.queue),
                 "active": self.sched.num_active,
             }, lane="serving")
-            if self.sched.num_active:
+            if self._has_decodable():
                 self._decode_all()
         self._step_i += 1
         self.metrics.export(self._step_i)
@@ -329,6 +327,22 @@ class _ServingBase:
         return [r.rid for r in self.sched.queue]
 
     # -- helpers ------------------------------------------------------ #
+
+    def _prefill_phase(self) -> None:
+        """Admit + prefill queued requests into free slots. Subclasses
+        with chunked prefill override this to pump in-flight prompt
+        chunks under the per-step token budget before admitting more —
+        chunk pumping must keep running while draining (those requests
+        hold slots), only NEW admissions stop."""
+        if self._draining:
+            return
+        while (adm := self.sched.pop_admissible()) is not None:
+            self._admit_one(*adm)
+
+    def _has_decodable(self) -> bool:
+        """Whether any slot has a pending token to decode this step
+        (chunk-prefilling slots don't, until their final chunk lands)."""
+        return self.sched.num_active > 0
 
     def _record_emitted(self, req: Request, prefill: bool) -> None:
         now = self.clock()
@@ -379,6 +393,17 @@ class ServingEngine(_ServingBase):
             lambda params, toks: apply_with_cache(
                 cfg, params, toks,
                 init_cache(cfg, toks.shape[0], toks.shape[1]), 0))
+        # suffix/chunked prefill over a gathered staging cache: the write
+        # offset is TRACED, so one compile serves every (matched, chunk)
+        # position and it retraces only per (chunk len, staging len)
+        # shape pair; staging buffers are donated chunk to chunk
+        self._suffix_prefill = jax.jit(
+            lambda params, toks, kc, vc, offset: apply_with_cache(
+                cfg, params, toks, {"k": kc, "v": vc}, offset),
+            donate_argnums=(2, 3))
+        # slot -> in-flight chunked-prefill state (staging cache, cursor)
+        self._chunking: Dict[int, dict] = {}
+        self._prefill_spent = 0   # prompt tokens prefilled this step
         if self.telemetry is not None:
             # decode must stay one-compile forever; prefill legitimately
             # retraces per length bucket, so it is deliberately unwatched
@@ -450,6 +475,10 @@ class ServingEngine(_ServingBase):
     def prefill_compile_count(self) -> int:
         return getattr(self._prefill_step, "_cache_size", lambda: -1)()
 
+    @property
+    def chunk_prefill_compile_count(self) -> int:
+        return getattr(self._suffix_prefill, "_cache_size", lambda: -1)()
+
     def _pick_token(self, logits_1d, req: Request) -> int:
         """Prefill-time next-token selection (one request, host-driven).
         Greedy path is the same raw argmax make_generator uses; sampling
@@ -465,9 +494,170 @@ class ServingEngine(_ServingBase):
         key = request_sample_key(req.seed, len(req.generated))
         return int(jax.random.categorical(key, filtered, axis=-1)[0])
 
+    # -- admission: full, suffix, and chunked prefill ------------------ #
+
+    def _budget_ok(self) -> bool:
+        b = self.scfg.prefill_token_budget
+        return b is None or self._prefill_spent < b
+
+    def _prefill_phase(self) -> None:
+        """Chunk-aware prefill phase: pump in-flight prompt chunks, then
+        admit queued requests, all under ``prefill_token_budget`` prompt
+        tokens per step (budget is a high-water mark, not a hard cap —
+        the launch that crosses it still runs, so progress is guaranteed
+        and a prompt longer than the budget cannot starve)."""
+        self._prefill_spent = 0
+        self._sweep_chunk_states()
+        for slot in sorted(self._chunking):
+            if not self._budget_ok():
+                break
+            self._pump_slot(slot, self._chunking[slot])
+        if self._draining:
+            return
+        while self._budget_ok() and \
+                (adm := self.sched.pop_admissible()) is not None:
+            self._admit_one(*adm)
+
+    def _has_decodable(self) -> bool:
+        return any(req is not None and s not in self._chunking
+                   for s, req in enumerate(self.sched.slots))
+
+    def _sweep_chunk_states(self) -> None:
+        """Drop chunk states whose request no longer holds the slot
+        (preempted or expired mid-prefill). Nothing to undo: chunked
+        prefill stages into a private dense cache and touches the pool
+        only at finalize, so abandoning the state abandons nothing."""
+        for slot in list(self._chunking):
+            if self.sched.slots[slot] is not self._chunking[slot]["req"]:
+                del self._chunking[slot]
+
     def _admit_one(self, slot: int, req: Request, blocks: List[int]) -> None:
-        """Length-bucketed prefill of the request's context into its
-        allocated blocks; emits the request's next token."""
+        """Prefill the request's context into its allocated blocks.
+
+        Three paths: (1) no cached prefix, prompt within one chunk —
+        the original full bucketed prefill; (2) cached prefix — gather
+        shared pages into a staging cache, forward only the suffix at
+        the matched offset, scatter back the private pages (the matched
+        boundary page's re-scatter is the CoW split); (3) long suffix —
+        same staging, but forwarded ``prefill_chunk`` tokens per engine
+        step so active decodes interleave instead of stalling behind one
+        long prompt."""
+        ctx = req.context
+        L = len(ctx)
+        plan = (self.scfg.prefill_plan(L, req.prefix_matched)
+                if (req.prefix_matched > 0
+                    or self.scfg.prefill_chunk is not None) else None)
+        if plan is None or (req.prefix_matched == 0 and plan[0] == 1):
+            self._prefill_full(slot, req, blocks)
+            self._prefill_spent += L
+            return
+        n_chunks, chunk, cache_len = plan
+        m = req.prefix_matched
+        bs = self.scfg.block_size
+        page_to_block = [NULL_BLOCK] * (cache_len // bs)
+        for i in range(req.prefix_shared_blocks):
+            page_to_block[i] = blocks[i]
+        if req.prefix_src is not None:
+            page_to_block[req.prefix_shared_blocks] = req.prefix_src[0]
+        k_stage, v_stage = self.kv.gather_pages(page_to_block)
+        state = {
+            "req": req, "blocks": blocks, "m": m, "L": L,
+            "suffix": ctx[m:], "n": n_chunks, "chunk": chunk,
+            "cache_len": cache_len, "k": k_stage, "v": v_stage,
+            "next": 0,
+        }
+        self._chunking[slot] = state
+        self._pump_slot(slot, state)
+
+    def _pump_slot(self, slot: int, state: dict) -> None:
+        """Forward staged prompt chunks for one slot while the step
+        budget allows; the final chunk scatters the staging cache into
+        the pool and emits the request's first token."""
+        req = state["req"]
+        chunk = state["chunk"]
+        suffix = state["suffix"]
+        while state["next"] < state["n"] and self._budget_ok():
+            c = state["next"]
+            lo = c * chunk
+            hi = min(lo + chunk, len(suffix))
+            final = (c + 1) == state["n"]
+            if final:
+                cm = trace_span("serving/prefill", lane="serving",
+                                rid=req.rid, slot=slot,
+                                ctx_len=state["L"],
+                                bucket=state["cache_len"])
+            else:
+                cm = trace_span("serving/prefill_chunk", lane="serving",
+                                rid=req.rid, chunk=c, tokens=hi - lo)
+            with cm as _sp:
+                timer = self.metrics.timers(PREFILL_TIMER)
+                timer.safe_start()
+                toks = np.zeros((1, chunk), np.int32)
+                toks[0, :hi - lo] = suffix[lo:hi]
+                _pargs = (self.params, jnp.asarray(toks), state["k"],
+                          state["v"], state["m"] + lo)
+                logits, cache = self._suffix_prefill(*_pargs)
+                state["k"], state["v"] = cache["k"], cache["v"]
+                if final:
+                    self._finish_staged(req, state)
+                    tok = self._pick_token(logits[0, hi - lo - 1], req)
+                    req.generated.append(tok)
+                timer.stop(sync_with=self.kv.k if final else state["k"])
+                tel = self.telemetry
+                if tel is not None:
+                    if tel.cost_index is not None:
+                        # one compile per (chunk len, staging len) pair;
+                        # the traced offset keeps every chunk position
+                        # on the same program
+                        tel.cost_index.observe(
+                            f"serving/suffix_prefill"
+                            f"[s{chunk}c{state['cache_len']}]",
+                            self._suffix_prefill, _pargs)
+                    if tel.memwatch is not None:
+                        tel.memwatch.annotate(_sp, "prefill")
+            self._prefill_spent += hi - lo
+            self.metrics.record_prefill_chunk(hi - lo)
+            state["next"] += 1
+            if final:
+                del self._chunking[slot]
+                logger.debug(
+                    "serving: admitted %s to slot %d (ctx=%d matched=%d "
+                    "chunks=%d)", req.rid, slot, state["L"], state["m"],
+                    state["n"])
+                self._record_emitted(req, prefill=True)
+
+    def _finish_staged(self, req: Request, state: dict) -> None:
+        """Scatter the staged suffix into the slot's private blocks.
+        Pages fully covered by shared blocks stay mapped read-only (their
+        scatter target is the null block); the matched boundary page —
+        gathered shared rows plus freshly forwarded suffix rows — lands
+        in a private block, which IS the copy-on-write split. Then index
+        the prompt in the radix cache for the next request."""
+        bs = self.scfg.block_size
+        m, L, blocks = state["m"], state["L"], state["blocks"]
+        first = m // bs
+        page_to_block = [NULL_BLOCK] * (state["cache_len"] // bs)
+        for p in range(first, blocks_needed(L, bs)):
+            page_to_block[p] = blocks[p]
+        self.kv.write_pages(state["k"], state["v"], page_to_block)
+        if req.prefix_src is not None:
+            trace_instant("kv/cow_split", lane="serving", rid=req.rid,
+                          block=blocks[first], rows=req.prefix_src[1])
+            self.metrics.record_cow_split()
+        self.sched.release_prefix_src(req)
+        self.metrics.record_reuse(m, L)
+        self._index_prompt(req, blocks)
+
+    def _index_prompt(self, req: Request, blocks: List[int]) -> None:
+        if self.sched.prefix_cache is None:
+            return
+        n = blocks_needed(len(req.prompt), self.scfg.block_size)
+        self.sched.prefix_cache.insert(req.prompt, blocks[:n])
+
+    def _prefill_full(self, slot: int, req: Request,
+                      blocks: List[int]) -> None:
+        """Length-bucketed prefill of the request's whole context into
+        its allocated blocks; emits the request's next token."""
         ctx = req.context
         L = len(ctx)
         bucket = self.scfg.bucket_for(L)
@@ -498,6 +688,8 @@ class ServingEngine(_ServingBase):
                     tel.memwatch.annotate(_sp, "prefill")
         logger.debug("serving: admitted %s to slot %d (ctx=%d bucket=%d)",
                      req.rid, slot, L, bucket)
+        self.metrics.record_reuse(0, L)
+        self._index_prompt(req, blocks)
         self._record_emitted(req, prefill=True)
 
     def _decode_all(self) -> None:
@@ -511,7 +703,11 @@ class ServingEngine(_ServingBase):
         counts = np.zeros(N, np.int32)
         active = []
         for s, req in enumerate(self.sched.slots):
-            if req is None:
+            # chunk-prefilling slots have no pending token yet: their
+            # lane stays idle (all-null table, length 0) this step, so
+            # the decode program's shapes — and its single compile —
+            # are untouched by chunking
+            if req is None or s in self._chunking:
                 continue
             active.append((s, req))
             tables[s] = self.sched.slot_table_row(s)

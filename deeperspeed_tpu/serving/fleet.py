@@ -93,6 +93,10 @@ class ThreadReplica:
         # failover retries to this so retried requests never mix
         # token streams from two published versions
         self.version: Optional[int] = None
+        # live prefix-cache counters mirrored out of the engine each
+        # driver tick (read-only snapshot; the bench sums these across
+        # the fleet for its prefix_reuse block)
+        self.reuse_stats: Dict[str, int] = {}
         self._thread: Optional[threading.Thread] = None
         self._events: "queue.Queue[dict]" = queue.Queue()
         self._cmds: "queue.Queue[dict]" = queue.Queue()
@@ -239,6 +243,16 @@ class ThreadReplica:
             else:
                 time.sleep(self._poll_s)
             self.progress = int(eng.metrics.total_generated)
+            m = eng.metrics
+            if hasattr(m, "reuse_hits"):
+                self.reuse_stats = {
+                    "admissions": int(m.admissions),
+                    "reuse_hits": int(m.reuse_hits),
+                    "prefill_tokens": int(m.prefill_tokens),
+                    "tokens_saved": int(m.tokens_saved),
+                    "cow_splits": int(m.cow_splits),
+                    "prefill_chunks": int(m.prefill_chunks),
+                }
             for rid in tracked:
                 req = eng.get(rid)
                 if rid not in first_sent and req.first_token_t is not None:

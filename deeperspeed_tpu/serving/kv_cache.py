@@ -21,10 +21,22 @@ slot's pages back into a contiguous ``blocks_per_slot * block_size``
 view per layer — the XLA-gather formulation of paged attention; a Pallas
 kernel that walks the table in HBM without materializing the view is the
 planned TPU fast path (see docs/tutorials/serving.md).
+
+Prefix reuse generalizes the null-block trick into copy-on-write
+sharing: blocks are REFCOUNTED, and a ``PrefixCache`` (radix trie over
+token blocks) lets the scheduler map another request's already-prefilled
+prompt blocks into a new slot's table read-only. A shared block returns
+to the free list only when its last holder (requests AND the cache)
+drops it, so evicting one sharer never frees a block another slot still
+reads. The partially filled boundary block of a matched prefix is never
+shared in place — admission copies its matched rows into a private block
+(the CoW split, exactly once per admission) via the same gather/scatter
+page machinery the prefill path uses.
 """
 
+import itertools
 import math
-from typing import List, Optional
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -41,11 +53,20 @@ class OutOfBlocks(Exception):
 
 
 class BlockAllocator:
-    """Free-list allocator over the physical blocks of the KV pool.
+    """Refcounted free-list allocator over the physical blocks of the KV
+    pool.
 
     Block 0 (NULL_BLOCK) is never handed out. alloc() is all-or-nothing:
     a request that cannot get every block it asked for gets none, and the
     caller leaves it queued (backpressure) or preempts a victim.
+
+    Sharing: ``alloc`` hands out blocks at refcount 1; ``ref`` adds a
+    holder (a slot table mapping a cached prefix block, or the prefix
+    cache's own resident reference); ``free`` drops one holder and the
+    block returns to the free list only at refcount 0. Callers that never
+    call ``ref`` see the original exclusive-ownership semantics
+    unchanged. ``reclaim`` (set by PrefixCache) is consulted when alloc
+    falls short, so cache-only blocks are evicted before backpressure.
     """
 
     def __init__(self, num_blocks: int):
@@ -54,7 +75,11 @@ class BlockAllocator:
         self.num_blocks = num_blocks
         # LIFO free list: recently freed (cache-warm) blocks reused first
         self._free: List[int] = list(range(num_blocks - 1, 0, -1))
-        self._allocated = set()
+        self._refs: Dict[int, int] = {}
+        # hook: callable(n_short) -> blocks actually released; installed
+        # by PrefixCache so allocation pressure evicts idle cached
+        # prefixes instead of backpressuring live traffic
+        self.reclaim = None
 
     @property
     def num_free(self) -> int:
@@ -62,34 +87,233 @@ class BlockAllocator:
 
     @property
     def num_allocated(self) -> int:
-        return len(self._allocated)
+        return len(self._refs)
 
     def can_alloc(self, n: int) -> bool:
         return n <= len(self._free)
+
+    def refcount(self, block: int) -> int:
+        return self._refs.get(block, 0)
 
     def alloc(self, n: int) -> Optional[List[int]]:
         """n blocks, or None when the pool cannot satisfy the request."""
         if n < 0:
             raise ValueError(f"cannot alloc {n} blocks")
+        if n > len(self._free) and self.reclaim is not None:
+            self.reclaim(n - len(self._free))
         if n > len(self._free):
             return None
         blocks = [self._free.pop() for _ in range(n)]
-        self._allocated.update(blocks)
+        for b in blocks:
+            self._refs[b] = 1
         return blocks
+
+    def ref(self, block: int) -> None:
+        """Add a holder to an allocated block (shared-prefix mapping)."""
+        if block not in self._refs:
+            raise OutOfBlocks(
+                f"ref of unallocated block {block} "
+                f"(allocated={sorted(self._refs)})"
+            )
+        self._refs[block] += 1
 
     def free(self, blocks: List[int]) -> None:
         for b in blocks:
-            if b not in self._allocated:
+            n = self._refs.get(b)
+            if n is None:
                 raise OutOfBlocks(
                     f"double free / foreign free of block {b} "
-                    f"(allocated={sorted(self._allocated)})"
+                    f"(allocated={sorted(self._refs)})"
                 )
-            self._allocated.remove(b)
-            self._free.append(b)
+            if n > 1:
+                self._refs[b] = n - 1
+            else:
+                del self._refs[b]
+                self._free.append(b)
 
 
 def blocks_needed(n_tokens: int, block_size: int) -> int:
     return math.ceil(n_tokens / block_size) if n_tokens > 0 else 0
+
+
+# ------------------------------------------------------------------ #
+# prefix-radix KV index
+# ------------------------------------------------------------------ #
+
+
+class _RadixNode:
+    """One cached block of prompt tokens. Full nodes (len(tokens) ==
+    block_size) may have children; a shorter node is a terminal partial
+    leaf — the CoW-source boundary block of some cached prompt."""
+
+    __slots__ = ("tokens", "block", "children", "parent", "last_used")
+
+    def __init__(self, tokens: Tuple[int, ...], block: int, parent):
+        self.tokens = tokens
+        self.block = block
+        self.children: List["_RadixNode"] = []
+        self.parent = parent
+        self.last_used = 0
+
+
+def _common_prefix(a: Sequence[int], b: Sequence[int]) -> int:
+    n = 0
+    for x, y in zip(a, b):
+        if x != y:
+            break
+        n += 1
+    return n
+
+
+class PrefixCache:
+    """Radix trie over token blocks: the fleet-wide index of prompt KV
+    already resident in the paged pool.
+
+    Each node is one physical block's worth of tokens; the cache holds
+    its own allocator reference on every indexed block, so a cached
+    prefix outlives the request that prefilled it. ``match`` returns the
+    longest cached prefix of a prompt as (full shared blocks, partial
+    boundary source); ``insert`` indexes a freshly prefilled prompt,
+    deduping against existing nodes. Under allocation pressure the
+    allocator calls ``_reclaim`` and the cache drops least-recently-used
+    leaves whose blocks no live slot shares — a block some slot still
+    maps is dereferenced but NOT released (refcounts make that safe by
+    construction).
+    """
+
+    def __init__(self, allocator: BlockAllocator, block_size: int):
+        self.allocator = allocator
+        self.block_size = block_size
+        self._root = _RadixNode((), NULL_BLOCK, None)
+        self._tick = itertools.count(1)
+        # observability: the bench's prefix_reuse block reads these
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.indexed_blocks = 0
+        allocator.reclaim = self._reclaim
+
+    def match(self, tokens: Sequence[int]
+              ) -> Tuple[int, List[int], Optional[Tuple[int, int]]]:
+        """Longest cached prefix of ``tokens``.
+
+        Returns ``(matched_len, full_blocks, partial)``: full_blocks map
+        read-only into the slot's table; ``partial`` is ``(block, rows)``
+        when the match ends mid-block — the CoW source whose matched rows
+        admission copies into a private block. matched_len is capped at
+        ``len(tokens) - 1``: at least one token must remain to prefill,
+        because that forward produces the request's first-token logits.
+        """
+        bs = self.block_size
+        limit = len(tokens) - 1
+        node = self._root
+        full: List[int] = []
+        matched = 0
+        partial: Optional[Tuple[int, int]] = None
+        now = next(self._tick)
+        while matched < limit:
+            remaining = limit - matched
+            # never look past the cap: a partial-node match must not
+            # count tokens beyond limit, or an identical prompt would
+            # "fully" match and leave nothing to prefill
+            want = tokens[matched:matched + min(bs, remaining)]
+            descend = None
+            best_rows, best_child = 0, None
+            for ch in node.children:
+                n = _common_prefix(ch.tokens, want)
+                if n == bs == len(ch.tokens) and remaining > bs:
+                    descend = ch
+                    break
+                if n > best_rows:
+                    best_rows, best_child = n, ch
+            if descend is not None:
+                descend.last_used = now
+                full.append(descend.block)
+                matched += bs
+                node = descend
+                continue
+            if best_rows > 0:
+                best_child.last_used = now
+                partial = (best_child.block, best_rows)
+                matched += best_rows
+            break
+        if matched > 0:
+            self.hits += 1
+        else:
+            self.misses += 1
+        return matched, full, partial
+
+    def insert(self, tokens: Sequence[int], blocks: Sequence[int]) -> int:
+        """Index a freshly prefilled prompt: ``tokens`` live in
+        ``blocks`` (logical page order). Takes a cache-resident ref on
+        every newly indexed block; existing nodes dedupe (the duplicate
+        physical copy stays private to its request). Returns the number
+        of blocks newly indexed."""
+        bs = self.block_size
+        node = self._root
+        pos = 0
+        new = 0
+        now = next(self._tick)
+        while pos < len(tokens):
+            chunk = tuple(tokens[pos:pos + bs])
+            existing = None
+            for ch in node.children:
+                if ch.tokens == chunk:
+                    existing = ch
+                    break
+            if existing is not None:
+                existing.last_used = now
+                node = existing
+                pos += len(chunk)
+                continue
+            block = blocks[pos // bs]
+            self.allocator.ref(block)
+            child = _RadixNode(chunk, block, node)
+            child.last_used = now
+            node.children.append(child)
+            new += 1
+            if len(chunk) < bs:
+                break  # partial boundary blocks are terminal
+            node = child
+            pos += bs
+        self.indexed_blocks += new
+        return new
+
+    def _reclaim(self, n_short: int) -> int:
+        """Evict least-recently-used leaves until ``n_short`` blocks hit
+        the free list. Dropping the cache ref on a block a live slot
+        still shares releases nothing (and counts for nothing) — only
+        cache-only blocks actually free capacity."""
+        freed = 0
+        while freed < n_short:
+            victim = None
+            stack = [self._root]
+            while stack:
+                nd = stack.pop()
+                stack.extend(nd.children)
+                if nd is self._root or nd.children:
+                    continue
+                if victim is None or nd.last_used < victim.last_used:
+                    victim = nd
+            if victim is None:
+                break
+            if self.allocator.refcount(victim.block) == 1:
+                freed += 1
+            self.allocator.free([victim.block])
+            victim.parent.children.remove(victim)
+            self.indexed_blocks -= 1
+            self.evictions += 1
+        return freed
+
+    def stats(self) -> Dict[str, int]:
+        lookups = self.hits + self.misses
+        return {
+            "lookups": lookups,
+            "hits": self.hits,
+            "misses": self.misses,
+            "evictions": self.evictions,
+            "indexed_blocks": self.indexed_blocks,
+        }
 
 
 class PagedKVCache:
@@ -110,6 +334,8 @@ class PagedKVCache:
         self.allocator = BlockAllocator(scfg.num_blocks)
         self._write_prefill = jax.jit(_scatter_prefill_pages,
                                       donate_argnums=(0, 1))
+        # retraces once per page count (one per staging-cache bucket)
+        self._gather_pages = jax.jit(_gather_prefix_pages)
 
     def write_prefill(self, k_dense, v_dense, blocks: List[int],
                       length: int) -> None:
@@ -117,16 +343,38 @@ class PagedKVCache:
         allocated ``blocks``. ``bucket`` is a multiple of block_size;
         pages beyond ``blocks`` (prompt padding) go to the null block."""
         bs = self.scfg.block_size
+        assert len(blocks) == blocks_needed(length, bs), (blocks, length)
+        n_pages = k_dense.shape[2] // bs
+        self.write_pages(k_dense, v_dense,
+                         list(blocks) + [NULL_BLOCK] * (n_pages
+                                                        - len(blocks)))
+
+    def write_pages(self, k_dense, v_dense,
+                    page_to_block: Sequence[int]) -> None:
+        """Scatter selected pages of a dense (L, 1, bucket, Hkv, Dh)
+        cache into physical blocks: page ``i`` lands in
+        ``page_to_block[i]``. NULL_BLOCK entries discard the page (the
+        null block's content is never read unmasked) — the suffix-prefill
+        path uses that to skip pages whose data already lives in shared
+        blocks, writing only private pages. Re-scattering a matched
+        boundary page into a private block IS the CoW split: the dense
+        cache carries the gathered shared rows plus the new suffix rows,
+        so one scatter both copies and diverges."""
+        bs = self.scfg.block_size
         bucket = k_dense.shape[2]
         assert bucket % bs == 0, (bucket, bs)
-        n_pages = bucket // bs
-        assert len(blocks) == blocks_needed(length, bs), (blocks, length)
-        idx = jnp.asarray(
-            list(blocks) + [NULL_BLOCK] * (n_pages - len(blocks)),
-            jnp.int32,
-        )
+        assert len(page_to_block) == bucket // bs, (page_to_block, bucket)
+        idx = jnp.asarray(list(page_to_block), jnp.int32)
         self.k, self.v = self._write_prefill(self.k, self.v, k_dense,
                                              v_dense, idx)
+
+    def gather_pages(self, page_to_block: Sequence[int]):
+        """Gather pool pages into a dense (L, 1, n_pages * bs, Hkv, Dh)
+        staging cache — the read half of prefix reuse. Pages mapped to
+        NULL_BLOCK come back as garbage rows; callers overwrite or mask
+        them (same contract as the decode step's idle lanes)."""
+        idx = jnp.asarray(list(page_to_block), jnp.int32)
+        return self._gather_pages(self.k, self.v, idx)
 
 
 def _scatter_prefill_pages(k_pool, v_pool, k_dense, v_dense, idx):
@@ -139,6 +387,15 @@ def _scatter_prefill_pages(k_pool, v_pool, k_dense, v_dense, idx):
     # content is never read unmasked, so last-writer-wins is fine
     return (k_pool.at[:, idx].set(pages_k.astype(k_pool.dtype)),
             v_pool.at[:, idx].set(pages_v.astype(v_pool.dtype)))
+
+
+def _gather_prefix_pages(k_pool, v_pool, idx):
+    """Pool pages at idx -> dense (L, 1, n_pages * bs, Hkv, Dh) pair."""
+    L, _, bs, Hkv, Dh = k_pool.shape
+    n = idx.shape[0]
+    k = k_pool[:, idx].reshape(L, 1, n * bs, Hkv, Dh)
+    v = v_pool[:, idx].reshape(L, 1, n * bs, Hkv, Dh)
+    return k, v
 
 
 def paged_attend(k_pool_l, v_pool_l, q, k_new, v_new, tables, lengths,

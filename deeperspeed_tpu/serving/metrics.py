@@ -147,6 +147,16 @@ class ServingMetrics:
         self.decode_steps = 0
         self.prefills = 0
         self.preemptions = 0
+        # prefix reuse / chunked prefill: admissions is every context
+        # prefilled, prefill_tokens its token total; tokens_saved the
+        # part served from the radix cache instead of recomputed
+        self.admissions = 0
+        self.prefill_tokens = 0
+        self.reuse_hits = 0
+        self.tokens_saved = 0
+        self.cow_splits = 0
+        self.prefill_chunks = 0
+        self.chunk_tokens = 0
         self.finished: Dict[str, int] = {}
         self._start_t: Optional[float] = None
         self._end_t: Optional[float] = None
@@ -198,6 +208,43 @@ class ServingMetrics:
             self._c_tokens.inc()
             if ttft_s is not None:
                 self._h_ttft.observe(ttft_s)
+
+    def record_reuse(self, matched: int, ctx_len: int) -> None:
+        """One admission's prefix-cache outcome: ``matched`` of the
+        ``ctx_len`` context tokens came out of the radix cache (0 on a
+        miss — call this for EVERY admission so the saved fraction has
+        its denominator)."""
+        self.admissions += 1
+        self.prefill_tokens += ctx_len
+        if matched > 0:
+            self.reuse_hits += 1
+            self.tokens_saved += matched
+            if self.registry is not None:
+                self.registry.counter(
+                    "serving_prefix_reuse_hits_total",
+                    "Admissions that matched a cached prefix.").inc()
+                self.registry.counter(
+                    "serving_prefill_tokens_saved_total",
+                    "Prompt tokens served from the prefix cache instead "
+                    "of recomputed.").inc(matched)
+
+    def record_cow_split(self) -> None:
+        """A matched boundary page copied into a private block (exactly
+        one per admission whose match ends mid-block)."""
+        self.cow_splits += 1
+        if self.registry is not None:
+            self.registry.counter(
+                "serving_kv_cow_splits_total",
+                "Copy-on-write splits of shared boundary pages.").inc()
+
+    def record_prefill_chunk(self, tokens: int) -> None:
+        """One staged prompt-chunk forward (chunked/suffix prefill)."""
+        self.prefill_chunks += 1
+        self.chunk_tokens += tokens
+        if self.registry is not None:
+            self.registry.counter(
+                "serving_prefill_chunks_total",
+                "Staged prompt-chunk forwards.").inc()
 
     def record_decode_step(self, n_active: int, queue_depth: int,
                            now: float) -> None:
@@ -274,6 +321,20 @@ class ServingMetrics:
             "slot_occupancy": float(occ.mean()) if occ.size else 0.0,
             "queue_depth_max": int(max(self.queue_depth, default=0)),
             "slo": self.slo_tracker.summary(),
+            "prefix_reuse": {
+                "admissions": int(self.admissions),
+                "reuse_hits": int(self.reuse_hits),
+                "reuse_hit_rate": (self.reuse_hits / self.admissions
+                                   if self.admissions else 0.0),
+                "prefill_tokens": int(self.prefill_tokens),
+                "tokens_saved": int(self.tokens_saved),
+                "tokens_saved_frac": (self.tokens_saved
+                                      / self.prefill_tokens
+                                      if self.prefill_tokens else 0.0),
+                "cow_splits": int(self.cow_splits),
+                "prefill_chunks": int(self.prefill_chunks),
+                "chunk_tokens": int(self.chunk_tokens),
+            },
         }
 
     def export(self, step: int) -> None:

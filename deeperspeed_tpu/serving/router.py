@@ -39,6 +39,7 @@ budget. Nothing is silently lost.
 
 import dataclasses
 import time
+import zlib
 from collections import deque
 from typing import Callable, Dict, List, Optional, Sequence
 
@@ -79,6 +80,9 @@ class RouterRequest:
     cost_tokens: int                 # admission token-budget charge
     submit_t: float
     deadline_t: Optional[float]
+    # crc32 of the prompt's head tokens; same-prefix requests share it,
+    # and dispatch prefers the replica whose radix cache is warm for it
+    prefix_key: Optional[int] = None
     attempts: int = 0                # dispatches so far
     not_before: float = 0.0          # backoff gate for re-dispatch
     assigned: Optional[str] = None   # replica name, while in flight
@@ -133,6 +137,8 @@ class FleetRouter:
         self._pending: "deque[str]" = deque()
         self._inflight_tokens = 0
         self._next_rid = 0
+        # prefix_key -> replica name that last served it (warm cache)
+        self._affinity: Dict[int, str] = {}
         if registry is None:
             mon = get_monitor()
             registry = mon.registry if mon is not None else None
@@ -174,9 +180,15 @@ class FleetRouter:
         spec = {"rid": rid, "prompt": list(int(t) for t in prompt),
                 "max_new_tokens": max_new_tokens,
                 "temperature": float(temperature), "seed": int(seed)}
+        prefix_key = None
+        if self.rcfg.prefix_affinity:
+            head = spec["prompt"][:self.rcfg.affinity_prefix_len]
+            prefix_key = zlib.crc32(
+                ",".join(str(t) for t in head).encode("ascii"))
         self._reqs[rid] = RouterRequest(
             rid=rid, spec=spec, cost_tokens=cost, submit_t=now,
-            deadline_t=(now + deadline_s) if deadline_s else None)
+            deadline_t=(now + deadline_s) if deadline_s else None,
+            prefix_key=prefix_key)
         self._pending.append(rid)
         self._inflight_tokens += cost
         self.metrics.record_accept()
@@ -498,6 +510,21 @@ class FleetRouter:
                             "version lost its last replica").inc()
                     rec.version = None
             target = min(pool, key=lambda st: len(st.assigned))
+            # prefix affinity: same-prefix traffic goes back to the
+            # replica whose radix cache is warm for it, unless that
+            # replica is more than affinity_load_slack requests above
+            # the least-loaded choice (affinity must not build hot
+            # spots, and never overrides health — it only picks WITHIN
+            # the healthy pool)
+            if rec.prefix_key is not None:
+                warm_name = self._affinity.get(rec.prefix_key)
+                if warm_name is not None and warm_name != target.name:
+                    warm = next((st for st in pool
+                                 if st.name == warm_name), None)
+                    if warm is not None and (
+                            len(warm.assigned) <= len(target.assigned)
+                            + self.rcfg.affinity_load_slack):
+                        target = warm
             try:
                 target.replica.submit(rec.spec)
             except ReplicaUnavailableError:
@@ -509,6 +536,8 @@ class FleetRouter:
             rec.assigned = target.name
             if rec.version is None:
                 rec.version = self._replica_version(target)
+            if rec.prefix_key is not None:
+                self._affinity[rec.prefix_key] = target.name
             target.assigned.add(rid)
             # the flow-arrow source: the aggregator pairs this with the
             # replica-side serving/admit carrying the same rid

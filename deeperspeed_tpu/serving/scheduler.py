@@ -27,12 +27,13 @@ import dataclasses
 import itertools
 import time
 from collections import deque
-from typing import Callable, Deque, List, Optional
+from typing import Callable, Deque, List, Optional, Tuple
 
 from ..monitor.tracer import trace_instant
 from ..utils.logging import logger
 from .config import ServingConfig
-from .kv_cache import NULL_BLOCK, BlockAllocator, blocks_needed
+from .kv_cache import NULL_BLOCK, BlockAllocator, PrefixCache, \
+    blocks_needed
 
 QUEUED = "queued"
 ACTIVE = "active"
@@ -74,6 +75,13 @@ class Request:
     last_token_t: Optional[float] = None   # progress clock for timeouts
     finish_t: Optional[float] = None
     finish_reason: Optional[str] = None
+    # prefix reuse (set per admission, cleared on preemption): tokens
+    # matched in the radix cache, how many table entries are shared
+    # read-only blocks, and the CoW source (block, rows) when the match
+    # ends mid-block — the engine copies those rows at prefill time
+    prefix_matched: int = 0
+    prefix_shared_blocks: int = 0
+    prefix_src: Optional[Tuple[int, int]] = None
 
     @property
     def context(self) -> List[int]:
@@ -103,6 +111,11 @@ class Scheduler:
                  clock: Callable[[], float] = time.monotonic):
         self.scfg = scfg
         self.allocator = allocator
+        # radix prompt index: admissions match their longest cached
+        # prefix and share those blocks read-only (refcounted)
+        self.prefix_cache: Optional[PrefixCache] = (
+            PrefixCache(allocator, scfg.block_size)
+            if scfg.prefix_caching else None)
         self.clock = clock
         self.queue: Deque[Request] = deque()
         self.slots: List[Optional[Request]] = [None] * scfg.num_slots
@@ -153,10 +166,30 @@ class Scheduler:
     def has_work(self) -> bool:
         return bool(self.queue) or self.num_active > 0
 
+    def _match_prefix(self, req: Request):
+        """Longest cached prefix of the request's context, degraded to
+        no-match when the bucket table cannot shape a suffix prefill for
+        it (the engine would have to fall back to a full prefill, which
+        must then own every block)."""
+        if self.prefix_cache is None:
+            return 0, [], None
+        ctx = req.context
+        matched, full, partial = self.prefix_cache.match(ctx)
+        if matched and self.scfg.prefill_plan(len(ctx), matched) is None:
+            return 0, [], None
+        return matched, full, partial
+
     def pop_admissible(self):
         """(slot, request, blocks) for the queue head, or None when no
         slot is free / the pool cannot cover its context + one decode
-        write (backpressure: the head stays queued)."""
+        write (backpressure: the head stays queued).
+
+        With prefix caching on, the head is admitted by its longest
+        cached prefix: matched full blocks are ref'd and mapped into the
+        table read-only (table order == logical page order), and only
+        the remaining pages are allocated privately. The CoW source of a
+        mid-block match is ref'd too, released by the engine (or by
+        preemption/finish) once its rows are copied."""
         if not self.queue:
             return None
         try:
@@ -164,25 +197,54 @@ class Scheduler:
         except ValueError:
             return None
         req = self.queue[0]
+        matched, full, partial = self._match_prefix(req)
+        # ref shared blocks BEFORE allocating: alloc may reclaim
+        # cache-only blocks, and a matched block must not be evictable
+        # between the match and the table mapping
+        for b in full:
+            self.allocator.ref(b)
+        if partial is not None:
+            self.allocator.ref(partial[0])
         # +1: headroom for the first decode write, so a freshly admitted
         # request cannot be preempted before its first step
         need = blocks_needed(len(req.context) + 1, self.scfg.block_size)
-        blocks = self.allocator.alloc(need)
-        if blocks is None:
+        private = self.allocator.alloc(need - len(full))
+        if private is None:
+            if full:
+                self.allocator.free(full)
+            if partial is not None:
+                self.allocator.free([partial[0]])
             return None
+        blocks = full + private
         self.queue.popleft()
         req.state = ACTIVE
         req.slot = slot
         req.cached_len = len(req.context)
         req.admissions += 1
         req.kv_accrue_t = self.clock()
+        req.prefix_matched = matched
+        req.prefix_shared_blocks = len(full)
+        req.prefix_src = partial
         self.slots[slot] = req
         self.slot_blocks[slot] = blocks
         self._slot_admitted_at[slot] = next(self._admit_seq)
         trace_instant("serving/admit", lane="serving", rid=req.rid,
                       slot=slot, ctx_len=req.cached_len,
                       admissions=req.admissions)
+        if matched > 0:
+            trace_instant("kv/reuse", lane="serving", rid=req.rid,
+                          matched_tokens=matched,
+                          shared_blocks=len(full),
+                          ctx_len=len(req.context))
         return slot, req, blocks
+
+    def release_prefix_src(self, req: Request) -> None:
+        """Drop the admission-time ref on the CoW source block; called
+        by the engine after the copy, and by preemption/finish when the
+        request leaves its slot with the copy still pending."""
+        if req.prefix_src is not None:
+            self.allocator.free([req.prefix_src[0]])
+            req.prefix_src = None
 
     # ---------------------------------------------------------------- #
     # decode-time capacity
@@ -230,10 +292,13 @@ class Scheduler:
                       slot=slot, blocks_freed=len(self.slot_blocks[slot]))
         self._accrue_kv(slot)
         req.kv_accrue_t = None
+        self.release_prefix_src(req)
         self._release_slot(slot)
         req.state = QUEUED
         req.slot = -1
         req.cached_len = 0
+        req.prefix_matched = 0
+        req.prefix_shared_blocks = 0
         self.queue.appendleft(req)
         return req
 
@@ -264,6 +329,7 @@ class Scheduler:
         if req.state == ACTIVE:
             self._accrue_kv(req.slot)
             req.kv_accrue_t = None
+            self.release_prefix_src(req)
             self._release_slot(req.slot)
         elif req.state == QUEUED:
             self.queue.remove(req)

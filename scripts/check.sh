@@ -34,6 +34,26 @@ for trace in traces/serving_bench_trace.json traces/obs_drill_merged.json; do
         --max-residual 0.05 "$trace"
 done
 
+echo "== prefix-reuse smoke (shared-prefix bench, reuse must hit) =="
+# the reuse path end to end on a small trace: dual-pass bench (baseline
+# vs reuse+chunked), the reuse pass must actually hit the radix cache,
+# and the doctor must still explain the fresh trace's tail
+JAX_PLATFORMS=cpu python scripts/serving_bench.py --slo --shared-prefix \
+    --requests 12 --d-model 64 \
+    --out /tmp/reuse_smoke.json --trace /tmp/reuse_smoke_trace.json
+python - <<'EOF'
+import json
+out = json.load(open("/tmp/reuse_smoke.json"))
+pr = out["prefix_reuse"]
+assert pr["reuse_hit_rate"] > 0, pr
+assert pr["tokens_saved"] > 0, pr
+assert out["decode_compiles"] == 1, out
+print(f"  reuse_hit_rate={pr['reuse_hit_rate']} "
+      f"tokens_saved_frac={pr['tokens_saved_frac']}")
+EOF
+JAX_PLATFORMS=cpu python -m deeperspeed_tpu.monitor.slo \
+    --max-residual 0.05 /tmp/reuse_smoke_trace.json
+
 echo "== autotune smoke (quick space, rank-only) =="
 # the config-search pipeline end to end on a small space: enumerate ->
 # AOT-price -> emit + provenance self-check (<60s; measured confirm

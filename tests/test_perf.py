@@ -215,11 +215,13 @@ def test_memwatch_postmortem_through_flight(tmp_path):
     fl = flight_mod.FlightRecorder(fpath, capacity=64)
     tr = Tracer(ring_size=128, flight=fl)
     set_tracer(tr)
-    x = jnp.ones((32, 32))  # a live buffer the dump must see
+    # a live buffer the dump must see — big enough to stay in the
+    # top-k cut even when earlier suite modules left arrays alive
+    x = jnp.ones((1024, 1024))
     mw = MemWatch(top_k=4)
     payload = mw.post_mortem("test oom")
     assert payload["live_buffers"] >= 1
-    assert any(b["shape"] == "32x32" for b in payload["buffers"])
+    assert any(b["shape"] == "1024x1024" for b in payload["buffers"])
     for b in payload["buffers"]:
         assert set(b) == {"shape", "dtype", "nbytes", "sharding"}
     fl.flush()

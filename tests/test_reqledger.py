@@ -293,12 +293,18 @@ def test_committed_drill_trace_token_exactness():
 @pytest.mark.skipif(
     not os.path.exists(os.path.join(TRACES, "serving_bench_trace.json")),
     reason="committed bench trace not present")
-def test_committed_bench_trace_p99_is_hol_blocking():
+def test_committed_bench_trace_p99_not_hol_dominated():
+    """The committed trace is the --shared-prefix bench's REUSE pass:
+    prefix reuse + chunked prefill exist to kill head-of-line blocking,
+    so the p99 victim must no longer be hol_blocking-dominated (the
+    baseline pass of the same traffic is — BENCH_serving.json carries
+    both hol_blocking totals), while attribution still explains the
+    tail."""
     report = build_ledger(
         os.path.join(TRACES, "serving_bench_trace.json"))
     victim = report["p99_victim"]
-    assert victim["dominant_bucket"] == "hol_blocking"
-    assert victim["top_blocker"] is not None
+    assert victim["dominant_bucket"] != "hol_blocking"
+    assert victim["dominant_bucket"] != "residual"
     assert report["worst_residual_fraction"] <= 0.05
     for rid, row in report["requests"].items():
         c = row["cost"]

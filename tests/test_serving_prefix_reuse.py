@@ -1,0 +1,366 @@
+"""Prefix-radix KV reuse + chunked prefill: allocator refcount
+invariants, radix longest-prefix-match edge cases, copy-on-write
+exactly-once semantics under sharing and preemption, token identity of
+cache-hit vs cache-miss and chunked vs unchunked serving, and the
+one-compile decode guarantee with chunking on."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeperspeed_tpu.models.gpt import GPTConfig, make_gpt
+from deeperspeed_tpu.serving import (
+    BlockAllocator,
+    ServingConfig,
+    ServingEngine,
+    blocks_needed,
+)
+from deeperspeed_tpu.serving.kv_cache import (
+    NULL_BLOCK,
+    OutOfBlocks,
+    PrefixCache,
+)
+from deeperspeed_tpu.serving.scheduler import Request, Scheduler
+
+
+def _cfg(**kw):
+    d = dict(vocab_size=97, n_layer=2, n_head=2, d_model=32, max_seq=128,
+             remat=False, dtype=jnp.float32, attn_impl="xla")
+    d.update(kw)
+    return GPTConfig(**d)
+
+
+def _params(cfg):
+    init_fn, _, _, _ = make_gpt(cfg)
+    return init_fn(jax.random.PRNGKey(0))
+
+
+def _prompt(n, seed=0):
+    return np.random.RandomState(seed).randint(0, 97, (n,)).tolist()
+
+
+# ------------------------------------------------------------------ #
+# allocator refcounts
+# ------------------------------------------------------------------ #
+
+
+def test_allocator_ref_delays_free():
+    a = BlockAllocator(8)
+    (b,) = a.alloc(1)
+    a.ref(b)
+    assert a.refcount(b) == 2
+    a.free([b])                        # one holder left
+    assert a.refcount(b) == 1
+    assert b not in a._free
+    a.free([b])                        # last holder: block returns
+    assert a.refcount(b) == 0
+    assert b in a._free
+    with pytest.raises(OutOfBlocks):
+        a.free([b])                    # now it IS a double free
+
+
+def test_allocator_ref_of_unallocated_raises():
+    a = BlockAllocator(8)
+    with pytest.raises(OutOfBlocks, match="unallocated"):
+        a.ref(5)
+    with pytest.raises(OutOfBlocks):
+        a.ref(NULL_BLOCK)
+
+
+def test_allocator_reclaim_hook_consulted_when_short():
+    a = BlockAllocator(4)              # 3 usable
+    held = a.alloc(3)
+    calls = []
+
+    def reclaim(n_short):
+        calls.append(n_short)
+        a.free(held[:n_short])
+        return n_short
+
+    a.reclaim = reclaim
+    got = a.alloc(2)
+    assert calls == [2]
+    assert got is not None and len(got) == 2
+
+
+# ------------------------------------------------------------------ #
+# radix longest-prefix match
+# ------------------------------------------------------------------ #
+
+
+def _cache(num_blocks=32, bs=4):
+    a = BlockAllocator(num_blocks)
+    return a, PrefixCache(a, bs)
+
+
+def test_match_empty_and_unknown_prompts_miss():
+    _, pc = _cache()
+    assert pc.match([]) == (0, [], None)
+    assert pc.match([1, 2, 3]) == (0, [], None)
+    assert pc.stats()["misses"] == 2 and pc.stats()["hits"] == 0
+
+
+def test_match_is_capped_one_token_short_of_full_hit():
+    """An identical prompt must NOT match fully: the suffix forward of
+    at least one token is what produces the first-token logits."""
+    a, pc = _cache(bs=4)
+    toks = list(range(8))              # exactly two full blocks
+    blocks = a.alloc(2)
+    pc.insert(toks, blocks)
+    matched, full, partial = pc.match(toks)
+    assert matched == 7                # len - 1, NOT 8
+    assert full == blocks[:1]          # second block only partially usable
+    assert partial == (blocks[1], 3)
+
+
+def test_match_partial_boundary_block():
+    a, pc = _cache(bs=4)
+    toks = list(range(6))              # one full block + 2-row partial
+    blocks = a.alloc(2)
+    pc.insert(toks, blocks)
+    # longer prompt sharing the cached prefix: full block shared, the
+    # partial boundary block is a CoW source for its 2 matched rows
+    matched, full, partial = pc.match(list(range(6)) + [50, 51, 52])
+    assert matched == 6
+    assert full == blocks[:1]
+    assert partial == (blocks[1], 2)
+    # divergence INSIDE the first block: nothing shareable block-wise
+    assert pc.match([0, 1, 99, 3, 4]) == (2, [], (blocks[0], 2))
+
+
+def test_insert_dedupes_and_refs_only_new_blocks():
+    a, pc = _cache(bs=4)
+    toks = list(range(8))
+    b1 = a.alloc(2)
+    assert pc.insert(toks, b1) == 2
+    assert all(a.refcount(b) == 2 for b in b1)   # owner + cache
+    # same prompt prefilled privately elsewhere: dedupe, no new refs
+    b2 = a.alloc(2)
+    assert pc.insert(toks, b2) == 0
+    assert all(a.refcount(b) == 1 for b in b2)
+    # an extension only indexes the new tail blocks
+    b3 = a.alloc(3)
+    assert pc.insert(list(range(12)), b3) == 1
+    assert a.refcount(b3[2]) == 2
+    assert a.refcount(b3[0]) == a.refcount(b3[1]) == 1
+
+
+def test_reclaim_evicts_lru_leaf_but_never_frees_shared_blocks():
+    a, pc = _cache(num_blocks=8, bs=4)          # 7 usable
+    b_old = a.alloc(2)
+    pc.insert(list(range(8)), b_old)            # older prefix
+    b_new = a.alloc(2)
+    pc.insert([90, 91, 92, 93, 94, 95, 96, 89], b_new)
+    a.free(b_old)                               # cache-only now
+    a.free(b_new)
+    # a live slot still shares the head block of the NEWER prefix
+    a.ref(b_new[0])
+    assert a.num_free == 3
+    # demands more than evicting cache-ONLY blocks can ever satisfy:
+    # reclaim drops every cache ref (LRU leaves first) but the shared
+    # head block frees nothing, so the alloc still backpressures
+    assert a.alloc(7) is None
+    assert pc.evictions == 4
+    assert pc.match(list(range(8)) + [1])[0] == 0   # index fully dropped
+    assert a.refcount(b_new[0]) == 1            # slot's ref survives...
+    assert b_new[0] not in a._free              # ...block NOT freed
+    a.free([b_new[0]])                          # last holder releases it
+    assert b_new[0] in a._free
+
+
+# ------------------------------------------------------------------ #
+# scheduler: shared admission + preemption safety
+# ------------------------------------------------------------------ #
+
+
+def _sched(**kw):
+    d = dict(num_slots=2, block_size=4, num_blocks=32, max_seq_len=64,
+             prefix_caching=True)
+    d.update(kw)
+    scfg = ServingConfig(**d)
+    alloc = BlockAllocator(scfg.num_blocks)
+    return scfg, alloc, Scheduler(scfg, alloc, clock=lambda: 0.0)
+
+
+def _admit(sched, rid, prompt, max_new=8):
+    sched.submit(Request(rid=rid, prompt=prompt, max_new_tokens=max_new))
+    adm = sched.pop_admissible()
+    assert adm is not None, rid
+    return adm
+
+
+def test_preempting_a_sharer_never_frees_shared_blocks():
+    scfg, alloc, sched = _sched()
+    prompt = _prompt(12)                         # 3 full blocks
+    slot_a, req_a, blocks_a = _admit(sched, "a", prompt)
+    sched.prefix_cache.insert(prompt, blocks_a[:3])
+    slot_b, req_b, blocks_b = _admit(sched, "b", prompt + _prompt(6, 1))
+    assert req_b.prefix_matched == 12
+    assert req_b.prefix_shared_blocks == 3
+    shared = blocks_b[:3]
+    assert shared == blocks_a[:3]
+    assert all(alloc.refcount(b) == 3 for b in shared)  # a + cache + b
+    sched._preempt(slot_b)                       # evict the sharer
+    assert all(alloc.refcount(b) == 2 for b in shared)  # a + cache live on
+    assert req_b.prefix_src is None
+    assert all(b not in alloc._free for b in shared)
+    # the original owner finishing still leaves the cache's copy resident
+    sched.finish(req_a, "length")
+    assert all(alloc.refcount(b) == 1 for b in shared)
+    assert all(b not in alloc._free for b in shared)
+
+
+def test_admission_alloc_failure_rolls_back_shared_refs():
+    scfg, alloc, sched = _sched(num_blocks=32)
+    prompt = _prompt(14)                         # 3 full + 2-row partial
+    slot_a, req_a, blocks_a = _admit(sched, "a", prompt)  # 4 blocks
+    sched.prefix_cache.insert(prompt, blocks_a[:4])
+    # pin the pool near-dry without cache reclaim muddying the refs
+    alloc.reclaim = None
+    alloc.alloc(alloc.num_free - 1)
+    refs_before = dict(alloc._refs)
+    sched.submit(Request(rid="b", prompt=prompt + _prompt(10, 1),
+                         max_new_tokens=8))
+    # match refs 3 full blocks + the CoW source, then the private alloc
+    # (4 blocks, 1 free) fails — every admission-time ref must roll back
+    assert sched.pop_admissible() is None        # backpressure
+    assert dict(alloc._refs) == refs_before
+    assert sched.queue[0].rid == "b"             # still queued, head
+
+
+# ------------------------------------------------------------------ #
+# engine: CoW split exactly once, token identity, one-compile decode
+# ------------------------------------------------------------------ #
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = _cfg()
+    return cfg, _params(cfg)
+
+
+def _engine(cfg, params, **kw):
+    d = dict(num_slots=2, block_size=4, num_blocks=64, max_seq_len=128,
+             prefill_buckets=(4, 8, 16, 32, 64, 128))
+    d.update(kw)
+    return ServingEngine(cfg, params, ServingConfig(**d))
+
+
+def test_cache_hit_tokens_identical_to_cache_miss(model):
+    """The whole point: a request served from shared prefix blocks (with
+    a CoW split) must emit bit-identical greedy tokens to the same
+    request served cold."""
+    cfg, params = model
+    sys_p = _prompt(14, 7)                       # partial boundary block
+    p1 = sys_p + _prompt(5, 8)
+    p2 = sys_p + _prompt(9, 9)
+
+    cold = ServingEngine(cfg, params,
+                         ServingConfig(num_slots=2, block_size=4,
+                                       num_blocks=64, max_seq_len=128))
+    r1 = cold.submit(p1, max_new_tokens=10)
+    r2 = cold.submit(p2, max_new_tokens=10)
+    ref = cold.run()
+
+    eng = _engine(cfg, params, prefix_caching=True)
+    h1 = eng.submit(p1, max_new_tokens=10)
+    eng.run()                                    # indexes p1
+    h2 = eng.submit(p2, max_new_tokens=10)       # hits the shared prefix
+    out = eng.run()
+    req2 = eng.get(h2)
+    assert req2.admissions == 1
+    assert eng.metrics.reuse_hits == 1
+    assert eng.metrics.cow_splits == 1           # exactly once
+    # 3 full blocks of sys_p + the 2 sys_p rows of p1's boundary block
+    assert eng.metrics.tokens_saved == 14
+    assert out[h2] == ref[r2]
+    assert eng.get(h1).output == ref[r1]
+
+
+def test_chunked_prefill_tokens_identical_to_unchunked(model):
+    cfg, params = model
+    prompts = [_prompt(37, 2), _prompt(18, 3), _prompt(61, 4)]
+
+    plain = _engine(cfg, params)
+    refs = [plain.submit(p, max_new_tokens=8) for p in prompts]
+    ref_out = plain.run()
+
+    eng = _engine(cfg, params, prefill_chunk=16, prefill_token_budget=32)
+    rids = [eng.submit(p, max_new_tokens=8) for p in prompts]
+    out = eng.run()
+    for r, rr in zip(rids, refs):
+        assert out[r] == ref_out[rr]
+    assert eng.metrics.prefill_chunks > 0
+
+
+def test_decode_stays_one_compile_under_chunking_and_reuse(model):
+    cfg, params = model
+    eng = _engine(cfg, params, prefix_caching=True, prefill_chunk=16,
+                  prefill_token_budget=32)
+    sys_p = _prompt(21, 5)
+    for i in range(3):
+        eng.submit(sys_p + _prompt(7, 10 + i), max_new_tokens=6)
+    eng.submit(_prompt(50, 6), max_new_tokens=6)  # long: chunks
+    eng.run()
+    assert eng.metrics.reuse_hits >= 1
+    assert eng.metrics.prefill_chunks >= 1
+    assert eng.decode_compile_count == 1
+    # chunk compiles are bounded by (chunk, cache-bucket) pairs actually
+    # seen, never by request count or offsets
+    assert 0 < eng.chunk_prefill_compile_count <= 4
+
+
+def test_cow_split_preserves_shared_block_contents(model):
+    """The divergent write lands in the sharer's PRIVATE copy; the
+    shared boundary block's rows stay bit-identical for the cache."""
+    cfg, params = model
+    eng = _engine(cfg, params, prefix_caching=True)
+    sys_p = _prompt(10, 11)                      # 2 full + 2-row partial
+    r1 = eng.submit(sys_p + _prompt(3, 12), max_new_tokens=4)
+    eng.run()
+    # the boundary block indexed by the cache for sys_p's tail
+    _, _, partial = eng.sched.prefix_cache.match(sys_p + [0])
+    assert partial is not None
+    src_block, rows = partial
+    before = np.asarray(eng.kv.k[:, src_block]).copy()
+    r2 = eng.submit(sys_p + _prompt(6, 13), max_new_tokens=4)
+    eng.run()
+    assert eng.metrics.cow_splits == 1
+    np.testing.assert_array_equal(np.asarray(eng.kv.k[:, src_block]),
+                                  before)
+    assert eng.get(r2).state == "finished"
+
+
+def test_preemption_mid_stream_with_reuse_stays_token_identical(model):
+    """Preempting a request that admitted via shared blocks re-prefills
+    from scratch on re-admission and continues the exact greedy stream;
+    the shared blocks survive for the other holder."""
+    cfg, params = model
+    scfg_kw = dict(num_slots=2, block_size=4, num_blocks=14,
+                   max_seq_len=32, prefill_buckets=(4, 8, 16, 32))
+    sys_p = _prompt(8, 20)
+    p1 = sys_p + _prompt(2, 21)
+    p2 = sys_p + _prompt(3, 22)
+
+    cold = _engine(cfg, params, **scfg_kw)
+    c1 = cold.submit(p1, max_new_tokens=12)
+    ref1 = cold.run()[c1]
+    cold2 = _engine(cfg, params, **scfg_kw)
+    c2 = cold2.submit(p2, max_new_tokens=12)
+    ref2 = cold2.run()[c2]
+
+    eng = _engine(cfg, params, prefix_caching=True, **scfg_kw)
+    h1 = eng.submit(p1, max_new_tokens=12)
+    eng.run()
+    h2 = eng.submit(p2, max_new_tokens=12)       # shares sys_p blocks
+    out = eng.run()
+    req2 = eng.get(h2)
+    # the tiny pool forces a preemption cycle while decoding
+    assert out[h2] == ref2
+    assert eng.get(h1).output == ref1
+    assert req2.state == "finished"
+    # leak check: finishing everything leaves only cache-resident blocks
+    held = eng.kv.allocator.num_allocated
+    assert held == eng.sched.prefix_cache.indexed_blocks

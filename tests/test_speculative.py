@@ -111,7 +111,10 @@ class TestSamplingAcceptance:
         from deeperspeed_tpu.models.speculative import (
             _pos_key, _prep_logits)
 
-        rng_tok, _ = jax.random.split(rng)
+        # the proposal stream is the FIRST of the generator's 3-way
+        # split; split(k, 2)[0] is a different key (split keys depend
+        # on the requested count), so derive it the same way
+        rng_tok, _, _ = jax.random.split(rng, 3)
         B, S = prompt.shape
         cache = init_cache(cfg, B, S + max_new)
         logits, cache = apply_with_cache(cfg, params, prompt, cache, 0)
