@@ -86,6 +86,14 @@ def _parse_args(argv=None):
                     help="write the winning config JSON here")
     ap.add_argument("--report", default=None,
                     help="write the full search report JSON here")
+    ap.add_argument("--draft-ks", default="0,4",
+                    help="comma list of speculative draft_k values to "
+                         "explore (0 = plain decode); each k > 0 adds a "
+                         "_spec{k} serving variant priced with its "
+                         "drafter pool and weights")
+    ap.add_argument("--spec-accept", type=float, default=0.7,
+                    help="modeled per-token draft/target agreement used "
+                         "to price speculative serving variants")
     # model facts (defaults = the tiny mesh_bench model: CPU-priceable)
     ap.add_argument("--vocab", type=int, default=256)
     ap.add_argument("--n-layer", type=int, default=2)
@@ -124,7 +132,10 @@ def _enumerate_space(args, model, budget):
     while (min_pool * (2 ** doublings) <= budget["hbm_bytes"]
            and doublings < 24):
         doublings += 1
-    servings = enumerate_serving_buckets(model, pool_doublings=doublings)
+    draft_ks = tuple(int(x) for x in
+                     str(getattr(args, "draft_ks", "0")).split(",") if x)
+    servings = enumerate_serving_buckets(model, pool_doublings=doublings,
+                                         draft_ks=draft_ks or (0,))
     return {
         "layouts": layouts, "comms": comms, "routes": routes,
         "servings": servings,
@@ -221,8 +232,10 @@ def run_search(args, log=print):
         space["routes"], comm_ranked[0].predicted_step_s, budget)
     kernel_ranked, kernel_pruned = rank_candidates(kernel_prices)
 
-    # stage D: serving shape buckets (analytic pool/bucket model)
-    serving_prices = [price_serving(s, model, budget)
+    # stage D: serving shape buckets (analytic pool/bucket model;
+    # speculative variants priced at the modeled acceptance)
+    serving_prices = [price_serving(s, model, budget,
+                                    accept_rate=args.spec_accept)
                       for s in space["servings"]]
     serving_ranked, serving_pruned = rank_candidates(serving_prices)
     for p in serving_pruned:

@@ -362,19 +362,46 @@ def price_serving(
     budget: Dict[str, float],
     *,
     dtype_bytes: int = 4,
+    accept_rate: float = 0.7,
 ) -> CandidatePrice:
     """Price a serving shape analytically: the KV pool + resident params
     must fit; among the fits, prefer the largest pool (fewest preempted
-    sequences) then the tighter bucket grid (less prefill padding)."""
+    sequences) then the tighter bucket grid (less prefill padding).
+
+    Speculative variants (``"speculative"`` in the block) add the
+    drafter's resident weights to the HBM gate (the drafter pool is
+    already inside ``cand.kv_pool_bytes``) and scale the decode-cost
+    component by the modeled round speedup at ``accept_rate`` per-token
+    draft/target agreement: a round of K+1 drafter steps (each
+    ``n_drafter/n_layer`` of a target step) plus one verify emits
+    ``1 + sum(p^i, i=1..K)`` tokens, so a weak drafter or an
+    over-greedy K prices WORSE than plain decode instead of silently
+    winning on pool size."""
     params = model.param_bytes(dtype_bytes)
-    need = cand.kv_pool_bytes + params
+    spec = (cand.block.get("speculative")
+            if isinstance(cand.block, dict) else None) or None
+    spec_speedup, drafter_params = 1.0, 0
+    if spec:
+        K = int(spec.get("draft_k", 4))
+        n_d = int((spec.get("drafter") or {}).get(
+            "n_layer", max(1, model.n_layer // 4)))
+        ratio = n_d / float(model.n_layer)
+        p = min(max(float(accept_rate), 0.0), 1.0)
+        emitted = 1.0 + sum(p ** i for i in range(1, K + 1))
+        round_cost = (K + 1) * ratio + 1.0   # in target-step units
+        spec_speedup = emitted / round_cost
+        # truncated drafter: its layers are resident copies of the
+        # target's first n_d — layer params dominate, embeddings shared
+        drafter_params = int(params * ratio)
+    need = cand.kv_pool_bytes + params + drafter_params
     price = CandidatePrice(
         name=cand.name, kind="serving",
         peak_hbm_bytes=float(need),
         detail={"serving": dict(cand.block),
                 "prefill_buckets": list(cand.prefill_buckets),
                 "kv_pool_bytes": cand.kv_pool_bytes,
-                "param_bytes": params})
+                "param_bytes": params,
+                "drafter_param_bytes": drafter_params})
     # waste proxy: mean padded fraction if prompts land uniformly in
     # [1, max bucket] — a finer grid scores lower
     buckets = sorted(cand.prefill_buckets)
@@ -387,16 +414,24 @@ def price_serving(
     pool_tokens = (int(cand.block["num_blocks"])
                    * int(cand.block["block_size"]))
     price.components = {"waste_frac": round(waste_frac, 6),
-                        "pool_tokens": float(pool_tokens)}
+                        "pool_tokens": float(pool_tokens),
+                        "decode_cost": round(1.0 / spec_speedup, 6)}
+    if spec:
+        price.components["spec_speedup"] = round(spec_speedup, 6)
+        price.components["spec_accept_rate_assumed"] = float(accept_rate)
     # smaller is better for the ranking key; feasible pools are ranked
-    # by padding waste, with a tiny tie-break rewarding pool headroom
-    price.predicted_step_s = waste_frac + 1.0 / (1.0 + pool_tokens)
+    # by decode cost then padding waste, with a tiny tie-break rewarding
+    # pool headroom. decode_cost is 1.0 for plain decode on every
+    # candidate, so the pre-speculative ordering is preserved exactly.
+    price.predicted_step_s = (1.0 / spec_speedup + waste_frac
+                              + 1.0 / (1.0 + pool_tokens))
     if need > budget["hbm_bytes"]:
         price.feasible = False
         price.reason = (
             f"HBM: KV pool {cand.kv_pool_bytes / (1 << 30):.3f} GiB + "
-            f"params {params / (1 << 30):.3f} GiB exceeds "
-            f"{budget['hbm_bytes'] / (1 << 30):.3f} GiB ({budget['source']})")
+            f"params {(params + drafter_params) / (1 << 30):.3f} GiB "
+            f"exceeds {budget['hbm_bytes'] / (1 << 30):.3f} GiB "
+            f"({budget['source']})")
     return price
 
 

@@ -265,16 +265,28 @@ def enumerate_serving_buckets(
     max_seq_len: Optional[int] = None,
     block_sizes: Sequence[int] = (16, 32),
     pool_doublings: int = 4,
+    draft_ks: Sequence[int] = (0,),
+    drafter_layers: Optional[int] = None,
 ) -> List[ServingCandidate]:
-    """Serving shape candidates over (block_size, num_blocks).
+    """Serving shape candidates over (block_size, num_blocks, draft_k).
 
     For each block size the pool is doubled from the minimum that can
     hold every decode slot at ``max_seq_len`` up through
     ``pool_doublings`` steps — deliberately overshooting so the HBM
     frontier is explored and the cost model always has an infeasible
     candidate to *report* (never to silently drop) on any platform.
+
+    ``draft_ks`` adds speculative-decoding variants: ``0`` is the plain
+    candidate, ``k > 0`` emits a ``_spec{k}`` variant whose block
+    carries a ``"speculative"`` sub-block (truncated drafter of
+    ``drafter_layers`` layers, defaulting to the engine's quarter-depth
+    rule). A spec variant's ``kv_pool_bytes`` includes the drafter's
+    own paged pool, so the HBM gate prices the pair, not just the
+    target.
     """
     max_seq_len = max_seq_len or max(model.seq, 64)
+    d_layers = (int(drafter_layers) if drafter_layers is not None
+                else max(1, model.n_layer // 4))
     out: List[ServingCandidate] = []
     for bs in block_sizes:
         if max_seq_len % bs:
@@ -282,21 +294,31 @@ def enumerate_serving_buckets(
         min_blocks = num_slots * (max_seq_len // bs) + 1  # +1: null block
         blocks = min_blocks
         for _ in range(pool_doublings + 1):
-            block = {
-                "num_slots": num_slots,
-                "block_size": bs,
-                "num_blocks": int(blocks),
-                "max_seq_len": max_seq_len,
-            }
-            sc = ServingConfig.from_dict(block)  # validator = admissibility
-            out.append(ServingCandidate(
-                name=f"bs{bs}_nb{int(blocks)}",
-                block=block,
-                prefill_buckets=tuple(sc.prefill_buckets),
-                kv_pool_bytes=sc.kv_pool_bytes(
-                    model.n_layer, model.kv_heads, model.head_dim,
-                    model.dtype_bytes),
-            ))
+            for k in draft_ks:
+                block = {
+                    "num_slots": num_slots,
+                    "block_size": bs,
+                    "num_blocks": int(blocks),
+                    "max_seq_len": max_seq_len,
+                }
+                name = f"bs{bs}_nb{int(blocks)}"
+                layers = model.n_layer
+                if k:
+                    block["speculative"] = {
+                        "draft_k": int(k),
+                        "drafter": {"n_layer": d_layers},
+                    }
+                    name += f"_spec{int(k)}"
+                    layers = model.n_layer + d_layers  # target + drafter
+                sc = ServingConfig.from_dict(block)  # validator gates
+                out.append(ServingCandidate(
+                    name=name,
+                    block=block,
+                    prefill_buckets=tuple(sc.prefill_buckets),
+                    kv_pool_bytes=sc.kv_pool_bytes(
+                        layers, model.kv_heads, model.head_dim,
+                        model.dtype_bytes),
+                ))
             blocks *= 2
     return out
 

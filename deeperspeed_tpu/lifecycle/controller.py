@@ -56,6 +56,10 @@ class VersionPublisher:
             ckpt_dir, keep_live=self.cfg.keep_live_versions)
         self.published = 0
         self._last_publish_step: Optional[int] = None
+        # when set (by the operator or a drafter-distillation job),
+        # every subsequent publish pairs this COMMITTED drafter tag
+        # with the target tag — the record rolls out as one unit
+        self.drafter_tag: Optional[str] = None
 
     def poll(self, engine=None) -> Optional[WeightVersion]:
         """Publish the ``latest`` tag if it is new and committed.
@@ -78,7 +82,7 @@ class VersionPublisher:
                 < self.cfg.publish_interval_steps):
             return None
         try:
-            rec = self.registry.publish(tag)
+            rec = self.registry.publish(tag, drafter=self.drafter_tag)
         except ValueError:
             # async writer still staging this tag, or it is torn; the
             # next boundary re-checks — commit is the publish gate
@@ -86,7 +90,8 @@ class VersionPublisher:
         self.published += 1
         self._last_publish_step = step
         trace_instant("lifecycle/publish", lane="lifecycle",
-                      version=rec.version, tag=rec.tag, step=rec.step)
+                      version=rec.version, tag=rec.tag, step=rec.step,
+                      drafter=rec.drafter)
         mon = get_monitor()
         if mon is not None:
             mon.registry.counter(
@@ -122,7 +127,13 @@ class RolloutDriver:
         self._thread: Optional[threading.Thread] = None
 
     def _checkpoint_pointer(self, rec: WeightVersion) -> dict:
-        return {"load_dir": self.registry.ckpt_dir, "tag": rec.tag}
+        ptr = {"load_dir": self.registry.ckpt_dir, "tag": rec.tag}
+        if rec.drafter is not None:
+            # (target, drafter) pair: the worker loads both sides from
+            # the same checkpoint dir, so a version's acceptance rate
+            # is comparable across every replica serving it
+            ptr["drafter_tag"] = rec.drafter
+        return ptr
 
     def poll_once(self) -> Optional[WeightVersion]:
         """One registry check; rolls the fleet when a newer live

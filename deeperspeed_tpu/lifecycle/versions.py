@@ -47,13 +47,21 @@ VERSIONS_FILE = "VERSIONS.json"
 
 @dataclasses.dataclass(frozen=True)
 class WeightVersion:
-    """One published weight set: an immutable (version, tag) pairing."""
+    """One published weight set: an immutable (version, tag) pairing.
+
+    When speculative decoding serves this version, ``drafter`` names
+    the drafter checkpoint tag published WITH the target — the rollout
+    ships both as one unit, because token-identical failover across a
+    mixed spec-on/spec-off fleet only needs the target weights pinned,
+    but acceptance-rate comparability needs the drafter pinned too.
+    Absent in pre-pair registry files (serde defaults it to None)."""
 
     version: int               # monotonic, never reused
     tag: str                   # COMMITTED checkpoint tag in load_dir
     step: Optional[int]        # trainer step the tag was saved at
     published_ts: float        # wall-clock publish time
     live: bool = True          # still routable / prune-protected
+    drafter: Optional[str] = None   # paired drafter checkpoint tag
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -66,6 +74,8 @@ class WeightVersion:
             step=(int(d["step"]) if d.get("step") is not None else None),
             published_ts=float(d.get("published_ts", 0.0)),
             live=bool(d.get("live", True)),
+            drafter=(str(d["drafter"])
+                     if d.get("drafter") is not None else None),
         )
 
 
@@ -143,27 +153,41 @@ class VersionRegistry:
     # mutations (trainer side)
 
     def publish(self, tag: str, step: Optional[int] = None,
-                now: Optional[float] = None) -> WeightVersion:
+                now: Optional[float] = None,
+                drafter: Optional[str] = None) -> WeightVersion:
         """Publish a COMMITTED checkpoint tag as the next version.
 
-        Re-publishing the tag of an existing live version is idempotent
-        (returns the existing record) — the controller may call this on
-        every save interval without minting duplicate versions.
+        Re-publishing the tag of an existing live version with the same
+        drafter pairing is idempotent (returns the existing record) —
+        the controller may call this on every save interval without
+        minting duplicate versions. The same target tag with a NEW
+        drafter mints a new version: the pair is the routable unit.
+
+        ``drafter`` names the drafter checkpoint tag published with the
+        target (speculative decoding); it must also be COMMITTED.
         """
         status = tag_status(os.path.join(self.ckpt_dir, str(tag)))
         if status not in ("committed", "legacy"):
             raise ValueError(
                 f"refusing to publish tag {tag!r}: status is {status!r} "
                 "(only committed checkpoints become weight versions)")
+        if drafter is not None:
+            dstatus = tag_status(os.path.join(self.ckpt_dir, str(drafter)))
+            if dstatus not in ("committed", "legacy"):
+                raise ValueError(
+                    f"refusing to publish drafter tag {drafter!r}: status "
+                    f"is {dstatus!r} (the pair rolls out as one unit, so "
+                    "both sides must be committed)")
         versions = self._read()
         for v in versions:
-            if v.live and v.tag == tag:
+            if v.live and v.tag == tag and v.drafter == drafter:
                 return v
         number = versions[-1].version + 1 if versions else 1
         rec = WeightVersion(
             version=number, tag=tag,
             step=step if step is not None else tag_step(tag),
             published_ts=float(now if now is not None else time.time()),
+            drafter=drafter,
         )
         versions.append(rec)
         # retire past the live window, never the newest keep_live

@@ -68,6 +68,18 @@ from .gpt import GPTConfig
 _prep_logits = prep_sampling_logits
 
 
+def engine_sample_key(seed, count):
+    """The serving engine's sampling-key contract: the key for a
+    request's ``count``-th generated token is
+    ``fold_in(fold_in(PRNGKey(0), seed), count)`` — a pure function of
+    (seed, token index) with no global stream, so retries and replica
+    moves replay token-identically. serving/engine.request_sample_key
+    delegates here; ``make_matched_speculative_generator`` uses the same
+    keys so its output matches plain engine decode token-for-token."""
+    key = jax.random.fold_in(jax.random.PRNGKey(0), seed)
+    return jax.random.fold_in(key, count)
+
+
 def _pos_key(rng, pos):
     """Per-absolute-position sampling key: deterministic in the position,
     independent of HOW decoding reached it — this is what makes
@@ -247,6 +259,143 @@ def make_speculative_generator(target_cfg: GPTConfig, draft_cfg: GPTConfig,
             # emitted this round, per row: accepted drafts then the
             # replacement / bonus at the first mismatch (or after full
             # acceptance); finished rows re-write their existing tokens
+            drafts_pad = jnp.concatenate(
+                [drafts, jnp.zeros((B, 1), jnp.int32)], axis=1)
+            emitted = jnp.where(idx[None] < n_acc[:, None], drafts_pad,
+                                bonus[:, None])
+            done = n >= max_new_tokens
+            cols = jnp.clip(n[:, None] + idx[None], 0, W - 1)
+            cur = out[rows_i[:, None], cols]
+            vals = jnp.where(done[:, None], cur, emitted)
+            out = out.at[rows_i[:, None], cols].set(vals)
+            n = jnp.where(done, n, n + n_acc + 1)
+            last = jnp.where(done, last, bonus)
+            return (out, n, last, t_cache, d_cache)
+
+        n0 = jnp.ones((B,), jnp.int32)
+        out, _, _, _, _ = jax.lax.while_loop(
+            cond, body, (out, n0, first, t_cache, d_cache))
+        return jnp.concatenate([prompt, out[:, :max_new_tokens]], axis=1)
+
+    return generate
+
+
+def make_matched_speculative_generator(target_cfg: GPTConfig,
+                                       draft_cfg: GPTConfig,
+                                       k_draft: int = 4):
+    """Speculative decoding under the SERVING ENGINE's determinism
+    contract (matched-key verification, the scheme serving/spec uses).
+
+    Instead of the Leviathan rejection rule, draft and target both
+    SAMPLE their next token with the same per-position key
+    ``engine_sample_key(seed, output_index)`` over their own
+    temperature/top-k-filtered logits; a draft token is accepted iff it
+    equals the target's own draw at that position. The emitted stream —
+    accepted drafts then the target's draw at the first disagreement —
+    is therefore EXACTLY the token sequence plain per-token decode of
+    the target would produce with the same (seed, index) keys, for any
+    draft model and any temperature (greedy included: temperature<=0
+    degenerates to argmax agreement). The draft only changes how many
+    target forwards that stream costs, never its contents, which is
+    what lets a fleet mix spec-on and spec-off replicas and retry
+    failed-over requests token-identically.
+
+    The price is a lower acceptance rate than rejection sampling at
+    high temperature (the draft must hit the target's exact draw, not
+    merely be plausible under p_t), so matched-key verification favors
+    drafts distilled from — or truncated out of — the target.
+
+    Returns generate(target_params, draft_params, prompt,
+    max_new_tokens, temperature=0.0, top_k=None, seeds=None) ->
+    (B, S+max_new_tokens). ``seeds`` is a (B,) int array of per-row
+    engine seeds (e.g. serving/engine.derive_request_seed); defaults to
+    zeros. temperature/top_k are static (one program per config)."""
+    assert target_cfg.vocab_size == draft_cfg.vocab_size, (
+        "target and draft must share a vocabulary")
+    K = int(k_draft)
+    assert K >= 1
+
+    @partial(jax.jit,
+             static_argnames=("max_new_tokens", "temperature", "top_k"))
+    def generate(target_params, draft_params, prompt, max_new_tokens: int,
+                 temperature: float = 0.0, top_k: Optional[int] = None,
+                 seeds=None):
+        B, S = prompt.shape
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        max_len = S + max_new_tokens + K + 1
+        for cfg in (target_cfg, draft_cfg):
+            if not cfg.rotary and max_len > cfg.max_seq:
+                raise ValueError(
+                    f"prompt ({S}) + max_new_tokens ({max_new_tokens}) + "
+                    f"draft slack ({K + 1}) exceeds max_seq ({cfg.max_seq})")
+        if seeds is None:
+            seeds = jnp.zeros((B,), jnp.int32)
+        seeds = jnp.asarray(seeds, jnp.int32)
+        rows_i = jnp.arange(B, dtype=jnp.int32)
+        sampling = temperature > 0.0
+
+        def choose(logits, idx):
+            """The engine's per-token selection: argmax when greedy,
+            else categorical over filtered logits with the matched
+            (seed, output-index) key. logits (B, V); idx (B,)."""
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            if not sampling:
+                return greedy
+            prepped = _prep_logits(logits, temperature, top_k)
+            return jax.vmap(
+                lambda sd, i, l: jax.random.categorical(
+                    engine_sample_key(sd, i), l, axis=-1)
+            )(seeds, idx, prepped).astype(jnp.int32)
+
+        t_cache = init_cache(target_cfg, B, max_len)
+        d_cache = init_cache(draft_cfg, B, max_len)
+        t_logits, t_cache = apply_with_cache(
+            target_cfg, target_params, prompt, t_cache, 0)
+        _, d_cache = apply_with_cache(
+            draft_cfg, draft_params, prompt, d_cache, 0)
+        first = choose(t_logits[:, -1], jnp.zeros((B,), jnp.int32))
+
+        W = max_new_tokens + K + 1
+        out = jnp.zeros((B, W), jnp.int32)
+        out = out.at[:, 0].set(first)
+        idx = jnp.arange(K + 1, dtype=jnp.int32)
+
+        def cond(carry):
+            n = carry[1]
+            return jnp.any(n < max_new_tokens)
+
+        def body(carry):
+            out, n, last, t_cache, d_cache = carry
+            offsets = S + n - 1
+
+            # draft K+1 proposals with the ENGINE's keys (the extra one
+            # only keeps the draft cache ahead on full acceptance)
+            def draft_step(carry, j):
+                tok, cache = carry
+                logits, cache = apply_with_cache(
+                    draft_cfg, draft_params, tok[:, None], cache,
+                    offsets + j)
+                nxt = choose(logits[:, -1], n + j)
+                return (nxt, cache), nxt
+
+            (_, d_cache), drafts_all = jax.lax.scan(
+                draft_step, (last, d_cache), jnp.arange(K + 1))
+            drafts = drafts_all[:K].T  # (B, K)
+
+            block = jnp.concatenate([last[:, None], drafts], axis=1)
+            t_logits, t_cache = apply_with_cache(
+                target_cfg, target_params, block, t_cache, offsets)
+            # target's own draw at every position, same keys as plain
+            # per-token decode would use
+            choice = jnp.stack(
+                [choose(t_logits[:, t], n + t) for t in range(K + 1)],
+                axis=1)  # (B, K+1)
+            matches = (drafts == choice[:, :K]).astype(jnp.int32)
+            n_acc = jnp.sum(jnp.cumprod(matches, axis=1), axis=1)
+            bonus = choice[rows_i, n_acc]
+
             drafts_pad = jnp.concatenate(
                 [drafts, jnp.zeros((B, 1), jnp.int32)], axis=1)
             emitted = jnp.where(idx[None] < n_acc[:, None], drafts_pad,

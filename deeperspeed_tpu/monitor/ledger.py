@@ -123,6 +123,17 @@ METRIC_SPECS: Tuple[MetricSpec, ...] = (
                     "cache under --shared-prefix traffic"),
     MetricSpec("serving.reuse_hit_rate", "BENCH_serving.json",
                ("prefix_reuse", "reuse_hit_rate"), "higher", 0.15),
+    # speculative decoding (PR 19): the --speculative dual-pass bench.
+    # Acceptance is a model/drafter property (tight band — a drop means
+    # the verify contract or the drafter sync broke, not the host);
+    # TPOT is cpu wall clock (wide band)
+    MetricSpec("serving.spec_accept_rate", "BENCH_serving.json",
+               ("speculative", "accept_rate"), "higher", 0.15,
+               note="drafted tokens the target verified and kept"),
+    MetricSpec("serving.tpot_ms", "BENCH_serving.json",
+               ("speculative", "tpot_ms"), "lower", 0.50, 1.0,
+               note="cpu wall clock: wide band; speculative pass of "
+                    "the dual-pass bench"),
     # fleet (PR 8)
     MetricSpec("fleet.fault.accepted", "BENCH_fleet.json",
                ("failover", "fault", "accepted"), "higher", 0.0,

@@ -162,6 +162,13 @@ class RequestTimeline:
     # router finishes only (rid, reason)
     finishes: List[Tuple[float, str, dict]] = \
         dataclasses.field(default_factory=list)
+    # (ts, accepted, k, emitted) ``spec/accept`` instants — one per
+    # speculative round the rid rode in; ``emitted`` counts the tokens
+    # the round actually appended (accepted drafts + bonus, truncated
+    # at EOS/length), which is what keeps token accounting exact when
+    # decode emits more than one token per span
+    spec_accepts: List[Tuple[float, int, int, int]] = \
+        dataclasses.field(default_factory=list)
 
     # -- derived ----------------------------------------------------- #
 
@@ -225,6 +232,13 @@ class TraceIndex:
     # lifecycle joins for the cost ledger's per-version axis
     rollouts: List[Tuple[float, str, object]]    # (ts, replica, version)
     repins: Dict[str, object]                    # rid -> version
+    # speculative decoding: per-round ``spec/draft`` / ``spec/verify``
+    # instants as (ts, n_active, dur_us) — the draft-vs-verify split of
+    # the decode bucket's device time
+    spec_drafts: List[Tuple[float, int, float]] = \
+        dataclasses.field(default_factory=list)
+    spec_verifies: List[Tuple[float, int, float]] = \
+        dataclasses.field(default_factory=list)
 
 
 def _args(ev: dict) -> dict:
@@ -241,6 +255,8 @@ def build_index(events: List[dict]) -> TraceIndex:
     steps_by_pid: Dict[object, list] = {}
     rollouts: List[Tuple[float, str, object]] = []
     repins: Dict[str, object] = {}
+    spec_drafts: List[Tuple[float, int, float]] = []
+    spec_verifies: List[Tuple[float, int, float]] = []
 
     def tl(rid) -> RequestTimeline:
         rid = str(rid)
@@ -319,6 +335,17 @@ def build_index(events: List[dict]) -> TraceIndex:
                              args.get("version")))
         elif name == "lifecycle/repin" and rid is not None:
             repins[str(rid)] = args.get("version")
+        elif name == "spec/draft":
+            spec_drafts.append((ts, int(args.get("n_active", 0) or 0),
+                                float(args.get("dur_us", 0.0) or 0.0)))
+        elif name == "spec/verify":
+            spec_verifies.append((ts, int(args.get("n_active", 0) or 0),
+                                  float(args.get("dur_us", 0.0) or 0.0)))
+        elif name == "spec/accept" and rid is not None:
+            acc = int(args.get("accepted", 0) or 0)
+            tl(rid).spec_accepts.append(
+                (ts, acc, int(args.get("k", 0) or 0),
+                 int(args.get("emitted", acc + 1) or (acc + 1))))
 
     for tline in tls.values():
         tline.dispatches.sort()
@@ -326,7 +353,10 @@ def build_index(events: List[dict]) -> TraceIndex:
         tline.chunks.sort()
         tline.decodes.sort()
         tline.finishes.sort()
+        tline.spec_accepts.sort()
     rollouts.sort()
+    spec_drafts.sort()
+    spec_verifies.sort()
     return TraceIndex(
         timelines=tls,
         prefills_by_pid=prefills_by_pid,
@@ -335,6 +365,8 @@ def build_index(events: List[dict]) -> TraceIndex:
         steps_by_pid=steps_by_pid,
         rollouts=rollouts,
         repins=repins,
+        spec_drafts=spec_drafts,
+        spec_verifies=spec_verifies,
     )
 
 
@@ -514,12 +546,22 @@ def request_cost(idx: TraceIndex, tline: RequestTimeline) -> dict:
         a = attempt_of(e)
         tokens[a] += 1
         device_us[a] += (e - s) / max(1, n)   # fair share of the batch
+    # speculative rounds append more than one token per decode span:
+    # the +1 above is the round's floor, spec/accept's ``emitted``
+    # carries the rest, so spec-on attempts stay exactly counted
+    for ts, _acc, _k, emitted in tline.spec_accepts:
+        tokens[attempt_of(ts)] += max(0, emitted - 1)
 
     fin = tline.engine_finish or {}
     final_tokens = tokens[-1]
     total = sum(tokens)
     replica = tline.dispatches[-1][1] if tline.dispatches else "local"
+    spec_drafted = sum(k for _ts, _a, k, _e in tline.spec_accepts)
+    spec_accepted = sum(a for _ts, a, _k, _e in tline.spec_accepts)
     return {
+        "spec_rounds": len(tline.spec_accepts),
+        "spec_accept_rate": round(spec_accepted / spec_drafted, 6)
+        if spec_drafted else 0.0,
         "attempts": n_attempts,
         "tokens_final": final_tokens,
         "tokens_total": total,
@@ -670,6 +712,37 @@ def build_ledger(events_or_path, top_blockers: int = 5,
     worst_residual = max(
         (requests[r].get("ttft", {}).get("residual_fraction", 0.0)
          for r in requests), default=0.0)
+
+    # speculative decoding: the draft-vs-verify split of decode device
+    # time plus fleet and per-rid acceptance — accept_rate is what the
+    # spec-on/spec-off routing decision and the bench's TPOT claim key
+    # on, so it lives in the doctored report, not just engine metrics
+    spec_drafted = spec_accepted = 0
+    spec_per_rid: Dict[str, dict] = {}
+    for rid, row in requests.items():
+        tline = idx.timelines[rid]
+        if not tline.spec_accepts:
+            continue
+        d = sum(k for _ts, _a, k, _e in tline.spec_accepts)
+        a = sum(acc for _ts, acc, _k, _e in tline.spec_accepts)
+        spec_drafted += d
+        spec_accepted += a
+        spec_per_rid[rid] = {
+            "rounds": len(tline.spec_accepts),
+            "accept_rate": round(a / d, 6) if d else 0.0,
+        }
+    speculative = {
+        "rounds": len(idx.spec_drafts),
+        "draft_ms": round(
+            sum(d for _t, _n, d in idx.spec_drafts) * 1e-3, 3),
+        "verify_ms": round(
+            sum(d for _t, _n, d in idx.spec_verifies) * 1e-3, 3),
+        "drafted": spec_drafted,
+        "accepted": spec_accepted,
+        "accept_rate": round(spec_accepted / spec_drafted, 6)
+        if spec_drafted else 0.0,
+        "per_rid": spec_per_rid,
+    }
     return {
         "requests": requests,
         "ttft": pct_block(ttfts),
@@ -685,6 +758,7 @@ def build_ledger(events_or_path, top_blockers: int = 5,
         "cost_per_1k_tokens": round(
             1000.0 * total_dev_s / total_tok, 6) if total_tok else 0.0,
         "economics": econ,
+        "speculative": speculative,
     }
 
 

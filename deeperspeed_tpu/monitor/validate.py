@@ -103,6 +103,13 @@ EVENT_ARG_SCHEMAS = {
     "lifecycle/publish": ("version", "tag", "step"),
     "lifecycle/rollout": ("replica", "version"),
     "lifecycle/repin": ("rid", "version"),
+    # speculative decoding (serving/spec): per-round draft/verify
+    # dispatches carry their device-seconds so the reqledger can split
+    # decode attribution into draft vs verify cost, and per-rid accept
+    # instants are what acceptance-rate accounting joins on
+    "spec/draft": ("n_active", "k", "dur_us"),
+    "spec/verify": ("n_active", "k", "dur_us"),
+    "spec/accept": ("rid", "accepted", "k", "emitted"),
 }
 
 # strict-mode name discipline: one prefix per subsystem that emits
@@ -111,7 +118,7 @@ KNOWN_EVENT_PREFIXES = (
     "engine/", "pipe/", "offload/", "comm/", "kernels/", "datapipe/",
     "resilience/", "serving/", "flight/", "run/", "goodput/", "trace/",
     "perf/", "mem/", "mesh/", "ablation/", "lifecycle/", "req/", "slo/",
-    "kv/",
+    "kv/", "spec/",
 )
 KNOWN_EVENT_NAMES = frozenset({
     "xla_compile", "recompile!", "process_name", "thread_name",

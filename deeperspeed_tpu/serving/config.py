@@ -29,6 +29,11 @@ _KNOWN_KEYS = frozenset({
     "max_new_tokens", "eos_token_id", "top_k", "request_timeout_s",
     "prefill_buckets", "seed", "fleet", "slo",
     "prefix_caching", "prefill_chunk", "prefill_token_budget",
+    "speculative",
+})
+
+_SPEC_KNOWN_KEYS = frozenset({
+    "enabled", "draft_k", "drafter", "drafter_checkpoint", "num_blocks",
 })
 
 _SLO_KNOWN_KEYS = frozenset({
@@ -91,6 +96,61 @@ class SLOConfig:
                 f"unknown slo config keys {sorted(unknown)}; known keys "
                 f"are {sorted(_SLO_KNOWN_KEYS)}")
         return cls(**d)
+
+
+@dataclasses.dataclass(frozen=True)
+class SpeculativeConfig:
+    """The ``"speculative"`` sub-block of the serving config: drafter-
+    backed speculative decoding (serving/spec/). Off unless the block is
+    present — the plain one-compile decode path is bit-for-bit untouched
+    without it.
+
+    The drafter is a second, smaller model sharing the target's
+    vocabulary. It proposes ``draft_k`` tokens per round from its own
+    paged KV pool; the target then scores all ``draft_k + 1`` positions
+    in one batched verify step and keeps the longest agreeing prefix
+    plus one bonus token. Greedy output is bit-identical to plain greedy
+    decode for ANY drafter — the drafter only changes how many target
+    forwards a token costs, never which token is emitted."""
+
+    # tokens drafted per speculative round (the verify step scores
+    # draft_k + 1 positions; static — it shapes the compiled programs)
+    draft_k: int = 4
+    # drafter model config (GPTConfig kwargs, e.g. {"n_layer": 1, ...});
+    # None means the engine derives a layer-truncated drafter from the
+    # target (serving/spec.truncated_drafter) unless explicit drafter
+    # params are passed to the engine
+    drafter: Optional[dict] = None
+    # checkpoint tag/path the drafter's weights load from (subprocess
+    # replicas; in-process engines usually pass drafter_params directly)
+    drafter_checkpoint: Optional[str] = None
+    # drafter KV pool size in blocks (its own BlockAllocator; block 0
+    # reserved exactly like the target pool); None = target num_blocks
+    num_blocks: Optional[int] = None
+
+    def __post_init__(self):
+        if self.draft_k < 1:
+            raise ValueError(
+                f"draft_k must be >= 1, got {self.draft_k}")
+        if self.num_blocks is not None and self.num_blocks < 2:
+            raise ValueError(
+                f"speculative num_blocks must be >= 2 (block 0 is the "
+                f"reserved null block), got {self.num_blocks}")
+        if self.drafter is not None and not isinstance(self.drafter, dict):
+            raise ValueError(
+                f"drafter must be a GPTConfig kwargs dict or None, got "
+                f"{type(self.drafter).__name__}")
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "SpeculativeConfig":
+        if d is None:
+            return cls()
+        unknown = set(d) - _SPEC_KNOWN_KEYS
+        if unknown:
+            raise ValueError(
+                f"unknown speculative config keys {sorted(unknown)}; "
+                f"known keys are {sorted(_SPEC_KNOWN_KEYS)}")
+        return cls(**{k: v for k, v in d.items() if k != "enabled"})
 
 
 @dataclasses.dataclass(frozen=True)
@@ -234,6 +294,9 @@ class ServingConfig:
     # tail-latency promises (burn-rate gauges + slo/violation instants);
     # None = no SLO accounting
     slo: Optional[SLOConfig] = None
+    # drafter-backed speculative decoding (serving/spec/); None = plain
+    # one-program decode, the default path, untouched
+    speculative: Optional[SpeculativeConfig] = None
 
     def __post_init__(self):
         if isinstance(self.fleet, dict):
@@ -242,6 +305,9 @@ class ServingConfig:
         if isinstance(self.slo, dict):
             object.__setattr__(self, "slo",
                                SLOConfig.from_dict(self.slo))
+        if isinstance(self.speculative, dict):
+            object.__setattr__(self, "speculative",
+                               SpeculativeConfig.from_dict(self.speculative))
         if self.num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {self.num_slots}")
         if self.block_size < 1:

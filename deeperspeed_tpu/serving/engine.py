@@ -48,6 +48,7 @@ import numpy as np
 from ..models.generation import apply_with_cache, init_cache, \
     prep_sampling_logits
 from ..models.gpt import GPTConfig, decoder_block, layer_norm
+from ..models.speculative import engine_sample_key
 from ..monitor import get_monitor, init_monitor
 from ..monitor.tracer import trace_counter, trace_instant, trace_span
 from ..utils.logging import logger
@@ -82,9 +83,11 @@ def derive_request_seed(base_seed: int, rid: str) -> int:
 def request_sample_key(seed: int, count: int):
     """PRNG key for a request's ``count``-th sampled token. Sampling is
     a pure function of (seed, token index): no engine-global key stream,
-    so a retried request replays token-identically anywhere."""
-    key = jax.random.fold_in(jax.random.PRNGKey(0), seed)
-    return jax.random.fold_in(key, count)
+    so a retried request replays token-identically anywhere. Delegates
+    to models/speculative.engine_sample_key — the single definition of
+    the key contract that plain decode, the spec draft/verify programs,
+    and make_matched_speculative_generator all share."""
+    return engine_sample_key(seed, count)
 
 
 # ------------------------------------------------------------------ #
@@ -289,7 +292,8 @@ class _ServingBase:
             for req in self.sched.expire_timeouts(now):
                 self.metrics.record_finish(req, now)
             self._prefill_phase()
-            for _ in self.sched.ensure_decode_capacity():
+            for _ in self.sched.ensure_decode_capacity(
+                    self._decode_window()):
                 self.metrics.record_preemption()
             trace_counter("serving/load", {
                 "queued": len(self.sched.queue),
@@ -344,6 +348,12 @@ class _ServingBase:
         (chunk-prefilling slots don't, until their final chunk lands)."""
         return self.sched.num_active > 0
 
+    def _decode_window(self) -> int:
+        """Tokens of KV headroom each active slot needs for the next
+        decode phase (1 for plain decode; draft_k + 1 with speculation
+        on, so a round's window of writes always has rows)."""
+        return 1
+
     def _record_emitted(self, req: Request, prefill: bool) -> None:
         now = self.clock()
         req.last_token_t = now    # progress clock for expire_timeouts
@@ -364,7 +374,7 @@ class ServingEngine(_ServingBase):
     def __init__(self, cfg: GPTConfig, params,
                  serving_config: Union[ServingConfig, dict, None] = None,
                  clock=time.monotonic, monitor=None, monitor_config=None,
-                 mesh=None, param_specs=None):
+                 mesh=None, param_specs=None, drafter_params=None):
         scfg = (serving_config if isinstance(serving_config, ServingConfig)
                 else ServingConfig.from_dict(serving_config))
         if not cfg.rotary and scfg.max_seq_len > cfg.max_seq:
@@ -409,6 +419,16 @@ class ServingEngine(_ServingBase):
             # retraces per length bucket, so it is deliberately unwatched
             self.telemetry.watchdog.watch("serving/decode_step",
                                           self._decode_step)
+        # speculative decoding: a SpecRuntime owns the drafter (params,
+        # paged pool, draft/verify programs) and takes over the decode
+        # phase; the decode step above stays as the fallback program for
+        # slots that cannot speculate a given round
+        self._spec = None
+        if scfg.speculative is not None:
+            from .spec.runtime import SpecRuntime
+
+            self._spec = SpecRuntime(self, scfg.speculative,
+                                     drafter_params)
 
     # -- mesh placement (dp×tp serving) -------------------------------- #
 
@@ -478,6 +498,30 @@ class ServingEngine(_ServingBase):
     @property
     def chunk_prefill_compile_count(self) -> int:
         return getattr(self._suffix_prefill, "_cache_size", lambda: -1)()
+
+    @property
+    def draft_compile_count(self) -> int:
+        return self._spec.draft_compile_count if self._spec else -1
+
+    @property
+    def verify_compile_count(self) -> int:
+        return self._spec.verify_compile_count if self._spec else -1
+
+    def _decode_window(self) -> int:
+        return self._spec.K + 1 if self._spec is not None else 1
+
+    def set_drafter_params(self, drafter_params) -> None:
+        """Swap the drafter's weights in place (same drafter config —
+        shapes must match, so the compiled draft program is reused).
+        The lifecycle rollout path: a (target, drafter) version pair
+        restarts the engine for the target side but can hot-swap the
+        drafter, whose KV is rebuilt lazily. No-op guard when
+        speculative decoding is off."""
+        if self._spec is None:
+            raise RuntimeError(
+                "set_drafter_params: speculative decoding is not enabled "
+                "on this engine")
+        self._spec.set_drafter_params(drafter_params)
 
     def _pick_token(self, logits_1d, req: Request) -> int:
         """Prefill-time next-token selection (one request, host-driven).
@@ -692,8 +736,21 @@ class ServingEngine(_ServingBase):
         self._index_prompt(req, blocks)
         self._record_emitted(req, prefill=True)
 
-    def _decode_all(self) -> None:
-        """One jitted decode step over the full slot array."""
+    def _active_decodable(self):
+        """(slot, request) pairs with a pending token this step.
+        Chunk-prefilling slots have no pending token yet: their lane
+        stays idle (all-null table, length 0), so the decode programs'
+        shapes — and their single compiles — are untouched by
+        chunking."""
+        return [(s, req) for s, req in enumerate(self.sched.slots)
+                if req is not None and s not in self._chunking]
+
+    def _dispatch_plain(self, active) -> np.ndarray:
+        """Run the plain decode program with ``active`` lanes populated
+        (the rest idle); returns the host-synced next-token array (N,).
+        The caller owns the surrounding span/metrics — this is both the
+        whole decode phase (speculation off) and the fallback program
+        for non-speculating slots (speculation on)."""
         N = self.scfg.num_slots
         tables = np.zeros((N, self.scfg.blocks_per_slot), np.int32)
         lengths = np.zeros(N, np.int32)
@@ -701,35 +758,38 @@ class ServingEngine(_ServingBase):
         temps = np.zeros(N, np.float32)
         seeds = np.zeros(N, np.int32)
         counts = np.zeros(N, np.int32)
-        active = []
-        for s, req in enumerate(self.sched.slots):
-            # chunk-prefilling slots have no pending token yet: their
-            # lane stays idle (all-null table, length 0) this step, so
-            # the decode program's shapes — and its single compile —
-            # are untouched by chunking
-            if req is None or s in self._chunking:
-                continue
-            active.append((s, req))
+        for s, req in active:
             tables[s] = self.sched.slot_table_row(s)
             lengths[s] = req.cached_len
             tokens[s] = req.pending_token
             temps[s] = req.temperature
             seeds[s] = req.seed
             counts[s] = len(req.generated)
+        _place = (self._place_slot_array if self.mesh is not None
+                  else jnp.asarray)
+        _dargs = (self.params, self.kv.k, self.kv.v, _place(tables),
+                  _place(lengths), _place(tokens),
+                  _place(temps), _place(seeds),
+                  _place(counts))
+        nxt, self.kv.k, self.kv.v = self._decode_step(*_dargs)
+        nxt = np.asarray(nxt)                   # device sync
+        self._last_dargs = _dargs
+        return nxt
+
+    def _decode_all(self) -> None:
+        """One decode phase over the full slot array: the speculative
+        round when enabled, else one jitted plain decode step."""
+        if self._spec is not None:
+            self._spec.decode_round()
+            return
+        active = self._active_decodable()
         with trace_span("serving/decode", lane="serving",
                         n_active=len(active),
                         rids=",".join(r.rid for _, r in active)) as _sp:
             _t0 = time.perf_counter()
             timer = self.metrics.timers(DECODE_TIMER)
             timer.safe_start()
-            _place = (self._place_slot_array if self.mesh is not None
-                      else jnp.asarray)
-            _dargs = (self.params, self.kv.k, self.kv.v, _place(tables),
-                      _place(lengths), _place(tokens),
-                      _place(temps), _place(seeds),
-                      _place(counts))
-            nxt, self.kv.k, self.kv.v = self._decode_step(*_dargs)
-            nxt = np.asarray(nxt)                   # device sync
+            nxt = self._dispatch_plain(active)
             timer.stop()
             tel = self.telemetry
             if tel is not None:
@@ -738,7 +798,8 @@ class ServingEngine(_ServingBase):
                     # is real; the AOT re-lower never touches the decode
                     # jit's cache (one-compile decode stays one-compile)
                     tel.cost_index.observe("serving/decode_step",
-                                           self._decode_step, _dargs)
+                                           self._decode_step,
+                                           self._last_dargs)
                     _stats = tel.cost_index.note_step(
                         "serving/decode_step", time.perf_counter() - _t0)
                     if _stats is not None:

@@ -97,6 +97,11 @@ class ThreadReplica:
         # driver tick (read-only snapshot; the bench sums these across
         # the fleet for its prefix_reuse block)
         self.reuse_stats: Dict[str, int] = {}
+        # speculative-decoding counters, same mirror discipline: empty
+        # when the engine runs plain decode, else rounds/drafted/
+        # accepted/fallback_lanes — the bench and mixed-fleet routing
+        # checks read acceptance without touching the engine thread
+        self.spec_stats: Dict[str, float] = {}
         self._thread: Optional[threading.Thread] = None
         self._events: "queue.Queue[dict]" = queue.Queue()
         self._cmds: "queue.Queue[dict]" = queue.Queue()
@@ -168,8 +173,22 @@ class ThreadReplica:
         (the driver thread rebuilds its engine from the factory). For
         thread replicas ``weights`` is a replacement zero-arg engine
         factory — in-process fleets share memory, so there is nothing
-        to serialize — or None to bump the version label only."""
-        if weights is not None:
+        to serialize — or None to bump the version label only.
+
+        A (target, drafter) PAIR push is a dict ``{"factory": ...,
+        "drafter_params": ...}``: the target factory (optional) stages
+        for the next restart as before, while the drafter weights are
+        hot-swapped on the driver thread via
+        ``engine.set_drafter_params`` — same drafter config, so the
+        compiled draft program survives the swap."""
+        if isinstance(weights, dict) and (
+                "factory" in weights or "drafter_params" in weights):
+            if weights.get("factory") is not None:
+                self._factory = weights["factory"]
+            if weights.get("drafter_params") is not None and self.alive:
+                self._cmds.put({"op": "drafter",
+                                "params": weights["drafter_params"]})
+        elif weights is not None:
             self._factory = weights
         self.version = int(version)
 
@@ -238,6 +257,13 @@ class ThreadReplica:
                              "error": f"{type(e).__name__}: {e}"})
                 elif cmd["op"] == "cancel":
                     eng.cancel(cmd["rid"], cmd["reason"])
+                elif cmd["op"] == "drafter":
+                    try:
+                        eng.set_drafter_params(cmd["params"])
+                    except Exception as e:  # noqa: BLE001 - to router
+                        self._events.put(
+                            {"ev": "err", "rid": None,
+                             "error": f"{type(e).__name__}: {e}"})
             if eng.has_work() and not self._stall_evt.is_set():
                 eng.step()
             else:
@@ -252,6 +278,16 @@ class ThreadReplica:
                     "tokens_saved": int(m.tokens_saved),
                     "cow_splits": int(m.cow_splits),
                     "prefill_chunks": int(m.prefill_chunks),
+                }
+            if getattr(m, "spec_rounds", 0):
+                self.spec_stats = {
+                    "rounds": int(m.spec_rounds),
+                    "drafted": int(m.spec_drafted),
+                    "accepted": int(m.spec_accepted),
+                    "emitted": int(m.spec_emitted),
+                    "fallback_lanes": int(m.spec_fallback_lanes),
+                    "accept_rate": (m.spec_accepted / m.spec_drafted
+                                    if m.spec_drafted else 0.0),
                 }
             for rid in tracked:
                 req = eng.get(rid)
@@ -399,8 +435,10 @@ class SubprocessReplica:
     def set_weights(self, weights: Optional[dict], version: int) -> None:
         """Stage a weight push; takes effect at the next ``restart()``
         (``start()`` rewrites spec.json from ``self._spec``). ``weights``
-        is the worker's checkpoint pointer — ``{"load_dir", "tag"}`` —
-        or None to bump the version label only."""
+        is the worker's checkpoint pointer — ``{"load_dir", "tag"}``,
+        plus a ``drafter_tag`` entry when the published version pairs a
+        drafter with the target — or None to bump the version label
+        only."""
         if weights is not None:
             self._spec["weights"] = dict(weights)
         self._spec["weights_version"] = int(version)

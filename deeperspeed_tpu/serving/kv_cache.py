@@ -324,14 +324,20 @@ class PagedKVCache:
     handles and the block accounting.
     """
 
-    def __init__(self, cfg: GPTConfig, scfg: ServingConfig):
+    def __init__(self, cfg: GPTConfig, scfg: ServingConfig,
+                 num_blocks: Optional[int] = None):
+        # num_blocks override: the speculative drafter's pool shares the
+        # target's geometry (block_size, table width) but sizes its own
+        # block count — and rides its own BlockAllocator instance of the
+        # same refcount/reclaim machinery
         self.cfg = cfg
         self.scfg = scfg
-        shape = (cfg.n_layer, scfg.num_blocks, scfg.block_size,
+        nb = scfg.num_blocks if num_blocks is None else int(num_blocks)
+        shape = (cfg.n_layer, nb, scfg.block_size,
                  cfg.kv_heads, cfg.head_dim)
         self.k = jnp.zeros(shape, cfg.dtype)
         self.v = jnp.zeros(shape, cfg.dtype)
-        self.allocator = BlockAllocator(scfg.num_blocks)
+        self.allocator = BlockAllocator(nb)
         self._write_prefill = jax.jit(_scatter_prefill_pages,
                                       donate_argnums=(0, 1))
         # retraces once per page count (one per staging-cache bucket)
@@ -396,6 +402,52 @@ def _gather_prefix_pages(k_pool, v_pool, idx):
     k = k_pool[:, idx].reshape(L, 1, n * bs, Hkv, Dh)
     v = v_pool[:, idx].reshape(L, 1, n * bs, Hkv, Dh)
     return k, v
+
+
+def paged_attend_multi(k_pool_l, v_pool_l, q, k_new, v_new, tables,
+                       lengths, write_blocks, write_offs):
+    """One layer of T-token paged-cache attention for all slots — the
+    ``paged_attend`` math generalized from a single new token to a
+    static window of T tokens per slot (the speculative verify step's
+    attention core; T = draft_k + 1).
+
+    q: (N, T, H, Dh); k_new/v_new: (N, T, Hkv, Dh) — the window's
+    projections per slot. write_blocks/write_offs: (N, T) physical
+    block + in-block offset for each new row (idle lanes target the
+    null block). Token t of slot i sits at logical position
+    ``lengths[i] + t`` and attends causally: keys at positions
+    ``<= lengths[i] + t``. Returns (ctx (N, T, H, Dh), k_pool_l',
+    v_pool_l'). Rows written for tokens the verify step later rejects
+    are stale-but-invisible — the next round's length-derived mask
+    hides them until they are overwritten (same contract as
+    models/speculative's rollback-free cache).
+    """
+    N, T = q.shape[0], q.shape[1]
+    Hq, Dh = q.shape[2], q.shape[3]
+    cdt = k_pool_l.dtype
+    # duplicate (null block, t) targets across idle lanes may race;
+    # block 0 is never read unmasked, so last-writer-wins is fine
+    k_pool_l = k_pool_l.at[write_blocks, write_offs].set(
+        k_new.astype(cdt))
+    v_pool_l = v_pool_l.at[write_blocks, write_offs].set(
+        v_new.astype(cdt))
+    bs = k_pool_l.shape[1]
+    view = tables.shape[1] * bs
+    k_c = k_pool_l[tables].reshape(N, view, k_pool_l.shape[2], Dh)
+    v_c = v_pool_l[tables].reshape(N, view, v_pool_l.shape[2], Dh)
+    Hkv = k_c.shape[2]
+    rep = Hq // Hkv
+    qg = q.reshape(N, T, Hkv, rep, Dh)
+    scores = jnp.einsum("bqhrd,bkhd->bhrqk", qg, k_c,
+                        preferred_element_type=jnp.float32)
+    scores = scores / math.sqrt(Dh)
+    key_pos = jnp.arange(view, dtype=jnp.int32)
+    q_pos = lengths[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+    valid = key_pos[None, None, :] <= q_pos[:, :, None]   # (N, T, view)
+    scores = jnp.where(valid[:, None, None, :, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    ctx = jnp.einsum("bhrqk,bkhd->bqhrd", probs, v_c)
+    return ctx.reshape(N, T, Hq, Dh), k_pool_l, v_pool_l
 
 
 def paged_attend(k_pool_l, v_pool_l, q, k_new, v_new, tables, lengths,

@@ -157,6 +157,17 @@ class ServingMetrics:
         self.cow_splits = 0
         self.prefill_chunks = 0
         self.chunk_tokens = 0
+        # speculative decoding: per-round draft/accept accounting plus
+        # the draft-vs-verify wall split (spec/runtime.decode_round)
+        self.spec_rounds = 0
+        self.spec_drafted = 0
+        self.spec_accepted = 0
+        self.spec_emitted = 0
+        self.spec_fallback_lanes = 0
+        self.spec_draft_s = 0.0
+        self.spec_verify_s = 0.0
+        self.spec_drafter_prefills = 0
+        self.spec_drafter_prefill_tokens = 0
         self.finished: Dict[str, int] = {}
         self._start_t: Optional[float] = None
         self._end_t: Optional[float] = None
@@ -267,6 +278,48 @@ class ServingMetrics:
         if self.registry is not None:
             self._c_preempt.inc()
 
+    def record_spec_round(self, n_spec: int, n_fallback: int,
+                          drafted: int, accepted: int, emitted: int,
+                          draft_s: float, verify_s: float) -> None:
+        """One speculative decode round. ``record_decode_step`` already
+        counted one token per active lane, so only the EXTRA tokens the
+        round emitted beyond that (accepted drafts past the first token
+        per speculating slot) are added here."""
+        self.spec_rounds += 1
+        self.spec_drafted += drafted
+        self.spec_accepted += accepted
+        self.spec_emitted += emitted
+        self.spec_fallback_lanes += n_fallback
+        self.spec_draft_s += draft_s
+        self.spec_verify_s += verify_s
+        extra = emitted - n_spec
+        self.total_generated += extra
+        if self.registry is not None:
+            if extra > 0:
+                self._c_tokens.inc(extra)
+            self.registry.counter(
+                "serving_spec_rounds_total",
+                "Speculative draft+verify decode rounds.").inc()
+            if drafted:
+                self.registry.counter(
+                    "serving_spec_drafted_total",
+                    "Draft tokens proposed to the verify step.",
+                ).inc(drafted)
+            if accepted:
+                self.registry.counter(
+                    "serving_spec_accepted_total",
+                    "Draft tokens accepted (emitted) by verification.",
+                ).inc(accepted)
+
+    def record_drafter_prefill(self, tokens: int) -> None:
+        """One drafter-pool suffix prefill (spec slot sync)."""
+        self.spec_drafter_prefills += 1
+        self.spec_drafter_prefill_tokens += tokens
+        if self.registry is not None:
+            self.registry.counter(
+                "serving_spec_drafter_prefills_total",
+                "Drafter-cache suffix prefills (slot syncs).").inc()
+
     def record_finish(self, req, now: float) -> None:
         self.finished[req.finish_reason] = (
             self.finished.get(req.finish_reason, 0) + 1)
@@ -334,6 +387,22 @@ class ServingMetrics:
                 "cow_splits": int(self.cow_splits),
                 "prefill_chunks": int(self.prefill_chunks),
                 "chunk_tokens": int(self.chunk_tokens),
+            },
+            "speculative": {
+                "rounds": int(self.spec_rounds),
+                "drafted": int(self.spec_drafted),
+                "accepted": int(self.spec_accepted),
+                "accept_rate": (self.spec_accepted / self.spec_drafted
+                                if self.spec_drafted else 0.0),
+                "emitted": int(self.spec_emitted),
+                "tokens_per_round": (self.spec_emitted / self.spec_rounds
+                                     if self.spec_rounds else 0.0),
+                "fallback_lanes": int(self.spec_fallback_lanes),
+                "draft_time_s": float(self.spec_draft_s),
+                "verify_time_s": float(self.spec_verify_s),
+                "drafter_prefills": int(self.spec_drafter_prefills),
+                "drafter_prefill_tokens": int(
+                    self.spec_drafter_prefill_tokens),
             },
         }
 

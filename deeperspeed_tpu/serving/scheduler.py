@@ -250,17 +250,20 @@ class Scheduler:
     # decode-time capacity
     # ---------------------------------------------------------------- #
 
-    def ensure_decode_capacity(self) -> List[Request]:
-        """Grow each active slot's block list to cover its next write;
-        preempt most-recently-admitted slots when the pool runs dry.
-        Returns the preempted requests (already requeued)."""
+    def ensure_decode_capacity(self, tokens: int = 1) -> List[Request]:
+        """Grow each active slot's block list to cover its next
+        ``tokens`` writes (1 for plain decode; a speculative round asks
+        for draft_k + 1, capped at the slot's table capacity); preempt
+        most-recently-admitted slots when the pool runs dry. Returns the
+        preempted requests (already requeued)."""
+        cap = self.scfg.blocks_per_slot * self.scfg.block_size
         preempted: List[Request] = []
         for slot in range(self.scfg.num_slots):
             while True:
                 req = self.slots[slot]
                 if req is None:
                     break
-                need = blocks_needed(req.cached_len + 1,
+                need = blocks_needed(min(req.cached_len + tokens, cap),
                                      self.scfg.block_size)
                 short = need - len(self.slot_blocks[slot])
                 if short <= 0:

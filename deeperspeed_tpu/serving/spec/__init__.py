@@ -1,0 +1,21 @@
+"""Drafter-backed speculative decoding for the serving engine.
+
+Enabled by the ``"speculative"`` sub-block of the serving config (see
+serving/config.SpeculativeConfig; off by default). The engine keeps
+exactly three compiled decode-path programs — drafter decode, target
+verify, fallback plain decode — and the emitted token stream is, by
+construction, identical to what plain decode would produce: greedy
+bit-identical, sampled a pure function of (per-rid seed, token index).
+docs/tutorials/serving.md covers drafter sizing, k tuning, and the
+determinism contract.
+"""
+
+from .runtime import SpecRuntime, truncated_drafter
+from .steps import make_draft_step, make_verify_step
+
+__all__ = [
+    "SpecRuntime",
+    "truncated_drafter",
+    "make_draft_step",
+    "make_verify_step",
+]

@@ -54,6 +54,31 @@ EOF
 JAX_PLATFORMS=cpu python -m deeperspeed_tpu.monitor.slo \
     --max-residual 0.05 /tmp/reuse_smoke_trace.json
 
+echo "== spec-decode smoke (dual-pass bench, drafts must land) =="
+# the speculative path end to end on a small trace: plain-vs-spec
+# dual-pass bench, the drafter must actually get tokens accepted, the
+# decode path must hold at exactly three compiled programs (plain
+# fallback + draft + verify), and the doctor must still explain the
+# fresh trace's tail
+JAX_PLATFORMS=cpu python scripts/serving_bench.py --speculative \
+    --requests 12 \
+    --out /tmp/spec_smoke.json --trace /tmp/spec_smoke_trace.json
+python - <<'EOF'
+import json
+out = json.load(open("/tmp/spec_smoke.json"))
+sp = out["speculative"]
+assert sp["accept_rate"] > 0, sp
+assert sp["rounds"] > 0, sp
+assert out["decode_compiles"] == 1, out
+assert out["draft_compiles"] == 1, out
+assert out["verify_compiles"] == 1, out
+print(f"  accept_rate={sp['accept_rate']} "
+      f"tokens_per_round={sp['tokens_per_round']} "
+      f"tpot_ms={sp['tpot_ms']} (baseline {sp['tpot_ms_baseline']})")
+EOF
+JAX_PLATFORMS=cpu python -m deeperspeed_tpu.monitor.slo \
+    --max-residual 0.05 /tmp/spec_smoke_trace.json
+
 echo "== autotune smoke (quick space, rank-only) =="
 # the config-search pipeline end to end on a small space: enumerate ->
 # AOT-price -> emit + provenance self-check (<60s; measured confirm

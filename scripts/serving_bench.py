@@ -52,7 +52,8 @@ def make_scfg(args, mode: str):
     """Serving config for one bench pass. ``plain`` honors the CLI knobs
     as given; ``baseline`` forces reuse AND chunking off (the
     shared-prefix comparison floor); ``reuse`` turns prefix caching on
-    and defaults chunking/budget when the CLI left them unset."""
+    and defaults chunking/budget when the CLI left them unset; ``spec``
+    is ``plain`` plus the speculative sub-block (truncated drafter)."""
     from deeperspeed_tpu.serving import ServingConfig
 
     chunk, budget = args.prefill_chunk, args.prefill_budget
@@ -61,6 +62,10 @@ def make_scfg(args, mode: str):
     elif mode == "reuse":
         chunk = chunk if chunk is not None else 4 * args.block_size
         budget = budget if budget is not None else 8 * args.block_size
+    speculative = None
+    if mode == "spec":
+        speculative = {"draft_k": args.draft_k,
+                       "drafter": {"n_layer": args.drafter_layers}}
     return ServingConfig(num_slots=args.num_slots,
                          block_size=args.block_size,
                          num_blocks=args.num_blocks,
@@ -68,6 +73,7 @@ def make_scfg(args, mode: str):
                          prefix_caching=(mode == "reuse"),
                          prefill_chunk=chunk,
                          prefill_token_budget=budget,
+                         speculative=speculative,
                          slo=({"ttft_p99_ms": 250.0, "tpot_p99_ms": 50.0,
                                "e2e_p99_ms": 2500.0}
                               if args.slo else None))
@@ -129,8 +135,22 @@ def run_pass(args, cfg, params, scfg, prompts, arrivals, news,
                 eng.run()
         warmed = True
     if not warmed:
-        eng.submit(prompts[0], max_new_tokens=2)
-        eng.run()
+        if scfg.speculative is not None:
+            # warm all three decode-path programs (draft, verify,
+            # fallback) AND the drafter-sync suffix shapes (pad bucket
+            # × page count) the measured prompts will hit — drafter
+            # sync compiles are per bucket combination, and one landing
+            # mid-measurement would charge XLA to some request's TPOT
+            for j, b in enumerate(scfg.prefill_buckets):
+                plen = min(max(1, b - 2), scfg.max_seq_len - 8)
+                eng.submit(wrng.integers(0, cfg.vocab_size,
+                                         plen).tolist(),
+                           max_new_tokens=8,
+                           request_id=f"warm-spec{j}")
+                eng.run()
+        else:
+            eng.submit(prompts[0], max_new_tokens=2)
+            eng.run()
     assert all(r.state == "finished" for r in eng.sched.finished)
     # drop warmup stats (Prometheus counters, being cumulative, keep the
     # warmup requests — the trace marks the measured-run boundary instead)
@@ -157,6 +177,9 @@ def run_pass(args, cfg, params, scfg, prompts, arrivals, news,
         "prefill_compiles": eng.prefill_compile_count,
         "chunk_prefill_compiles": eng.chunk_prefill_compile_count,
     }
+    if scfg.speculative is not None:
+        compiles["draft_compiles"] = eng.draft_compile_count
+        compiles["verify_compiles"] = eng.verify_compile_count
     if eng.telemetry is not None:
         from deeperspeed_tpu.monitor import shutdown_monitor
         from deeperspeed_tpu.monitor.validate import validate_file
@@ -223,17 +246,56 @@ def main():
                     help="per-step prefill token budget (default: "
                          "unbounded; 4*block_size in the --shared-prefix "
                          "reuse pass)")
+    ap.add_argument("--speculative", action="store_true",
+                    help="dual-pass speculative-decoding comparison: "
+                         "replay the same arrival schedule with plain "
+                         "decode (baseline) and with a truncated-drafter "
+                         "speculative engine, and emit a 'speculative' "
+                         "block (accept_rate, tpot_ms vs baseline, "
+                         "e2e_p99_ms). The target's upper layers are "
+                         "down-scaled by --spec-alpha so the truncated "
+                         "drafter is a FAITHFUL approximation — the CPU "
+                         "bench measures the engine at a realistic "
+                         "acceptance rate, not drafter quality")
+    ap.add_argument("--draft-k", type=int, default=4,
+                    help="draft tokens per speculative round")
+    ap.add_argument("--drafter-layers", type=int, default=None,
+                    help="truncated-drafter depth (default "
+                         "max(1, n_layer//4))")
+    ap.add_argument("--merge-out", action="store_true",
+                    help="with --speculative: merge the 'speculative' "
+                         "block and its compile counters into an "
+                         "existing --out file (the corpus "
+                         "BENCH_serving.json is written by the "
+                         "--slo --shared-prefix run) instead of "
+                         "overwriting it")
+    ap.add_argument("--spec-alpha", type=float, default=0.3,
+                    help="down-scale factor applied to the target's "
+                         "layers above the drafter cut in --speculative "
+                         "mode (makes drafter/target agreement high, as "
+                         "a distilled drafter's would be)")
     args = ap.parse_args()
+    if args.speculative and args.shared_prefix:
+        ap.error("--speculative and --shared-prefix are separate "
+                 "comparisons; run them as two bench invocations")
+    if args.merge_out and not args.speculative:
+        ap.error("--merge-out only applies to --speculative runs")
+    if args.drafter_layers is None:
+        args.drafter_layers = max(1, args.n_layer // 4)
     if args.rate is None:
         args.rate = 80.0 if args.slo else 8.0
     if args.num_blocks is None:
         args.num_blocks = 192 if args.shared_prefix else 64
     if args.d_model is None:
         args.d_model = 256 if args.shared_prefix else 64
-    if (args.slo or args.shared_prefix) and args.trace is None:
+    if (args.slo or args.shared_prefix or args.speculative) \
+            and args.trace is None:
         # attribution needs the trace; default it next to the other
-        # committed drill traces
-        args.trace = os.path.join("traces", "serving_bench_trace.json")
+        # committed drill traces (the spec pass gets its own file so
+        # the corpus keeps both drill traces side by side)
+        args.trace = os.path.join(
+            "traces", "serving_spec_trace.json" if args.speculative
+            else "serving_bench_trace.json")
 
     from deeperspeed_tpu.models.gpt import GPTConfig, make_gpt
 
@@ -242,6 +304,23 @@ def main():
                     remat=False, dtype=jnp.float32, attn_impl="xla")
     init_fn, _, _, _ = make_gpt(cfg)
     params = init_fn(jax.random.PRNGKey(args.seed))
+    if args.speculative:
+        # make the first --drafter-layers layers dominate the target's
+        # computation: random upper layers would make the truncated
+        # drafter a coin flip (sub-1% acceptance), which benchmarks
+        # nothing — a production drafter is distilled to agree. Scaling
+        # the layers ABOVE the cut by alpha keeps one weight set serving
+        # both passes (plain decode is bit-identical either way).
+        nd = args.drafter_layers
+        layers = params["layers"]
+        scale = jax.tree.map(
+            lambda x: x * np.where(
+                np.arange(x.shape[0]) < nd, 1.0,
+                args.spec_alpha).reshape(
+                    (x.shape[0],) + (1,) * (x.ndim - 1)).astype(x.dtype),
+            layers)
+        params = dict(params)
+        params["layers"] = scale
 
     # open-loop Poisson trace: arrival offsets + per-request lengths,
     # all drawn up front so the trace is reproducible from --seed
@@ -289,6 +368,7 @@ def main():
                    for k, n in zip(picks, suffix_lens)]
         news = rng.integers(4, 9, args.requests)
 
+    s_base = None
     if args.shared_prefix:
         # replay the same schedule twice: baseline (no reuse, no
         # chunking) into a throwaway trace, then the measured pass with
@@ -303,6 +383,21 @@ def main():
         scfg = make_scfg(args, "reuse")
         s, compiles = run_pass(args, cfg, params, scfg, prompts,
                                arrivals, news, sys_prompts, args.trace,
+                               args.metrics_port)
+    elif args.speculative:
+        # same dual-pass discipline as --shared-prefix: plain decode
+        # (the TPOT floor speculative must beat) into a throwaway
+        # trace, then the speculative pass into --trace. Same weights,
+        # same schedule — greedy outputs are token-identical by the
+        # engine's determinism contract, so the comparison is pure
+        # engine mechanics.
+        base_trace = args.trace + ".baseline"
+        s_base, _ = run_pass(args, cfg, params, make_scfg(args, "plain"),
+                             prompts, arrivals, news, None, base_trace,
+                             None)
+        scfg = make_scfg(args, "spec")
+        s, compiles = run_pass(args, cfg, params, scfg, prompts,
+                               arrivals, news, None, args.trace,
                                args.metrics_port)
     else:
         scfg = make_scfg(args, "plain")
@@ -324,6 +419,7 @@ def main():
             "d_model": args.d_model,
             "seed": args.seed,
             "shared_prefix": args.shared_prefix,
+            "speculative": args.speculative,
             "prefix_caching": scfg.prefix_caching,
             "prefill_chunk": scfg.prefill_chunk,
             "prefill_token_budget": scfg.prefill_token_budget,
@@ -343,7 +439,7 @@ def main():
     if args.trace is not None:
         out["trace"] = args.trace
     report = None
-    if args.slo or args.shared_prefix:
+    if args.slo or args.shared_prefix or args.speculative:
         # offline attribution over the trace just written: where every
         # request's TTFT went, who blocked whom, and what a kilotoken
         # costs — the keys PERF_LEDGER gates (serving.ttft_p99_ms,
@@ -368,6 +464,33 @@ def main():
             "hol_blocking_ms": report["buckets_total_ms"]["hol_blocking"],
         })
         out["prefix_reuse"] = pr
+    if args.speculative:
+        # before/after columns on the SAME arrival schedule and the SAME
+        # target weights: acceptance comes from the engine's own round
+        # accounting, the TPOT/e2e columns from the two passes' metrics
+        # and trace ledgers — the drafter must buy back more decode
+        # steps than its own draft+verify overhead costs
+        report_base = build_ledger(base_trace)
+        os.remove(base_trace)
+        sp = dict(s["speculative"])
+        for k in ("accept_rate", "tokens_per_round",
+                  "draft_time_s", "verify_time_s"):
+            sp[k] = round(sp[k], 4)
+        tpot_base_ms = s_base["tpot_s"]["p50"] * 1e3
+        tpot_ms = s["tpot_s"]["p50"] * 1e3
+        sp.update({
+            "draft_k": scfg.speculative.draft_k,
+            "n_layer": args.n_layer,
+            "drafter_layers": args.drafter_layers,
+            "spec_alpha": args.spec_alpha,
+            "tpot_ms_baseline": round(tpot_base_ms, 3),
+            "tpot_ms": round(tpot_ms, 3),
+            "tpot_reduction": (round(1.0 - tpot_ms / tpot_base_ms, 4)
+                               if tpot_base_ms > 0 else 0.0),
+            "e2e_p99_ms_baseline": report_base["e2e"]["p99_ms"],
+            "e2e_p99_ms": report["e2e"]["p99_ms"],
+        })
+        out["speculative"] = sp
     if args.slo:
         out["slo"] = {
             "targets": s["slo"],
@@ -379,6 +502,17 @@ def main():
             "top_blockers": report["top_blockers"],
             "worst_residual_fraction": report["worst_residual_fraction"],
         }
+    if args.merge_out and os.path.exists(args.out):
+        # corpus mode: BENCH_serving.json is written by the
+        # --slo --shared-prefix run; the speculative pass (mutually
+        # exclusive with it) contributes only its own headline block
+        # plus its compile counters, leaving every other row intact
+        with open(args.out) as f:
+            prev = json.load(f)
+        prev["speculative"] = out["speculative"]
+        for k in ("draft_compiles", "verify_compiles"):
+            prev[k] = out[k]
+        out = prev
     with open(args.out, "w") as f:
         json.dump(out, f, indent=2)
         f.write("\n")

@@ -155,6 +155,57 @@ def test_version_registry_publish_retire(tmp_path):
     assert reg.publish("global_step3").version == 3
 
 
+def test_version_pair_target_plus_drafter(tmp_path):
+    """Speculative serving rolls out (target, drafter) as ONE unit: the
+    record carries both tags, survives the JSON round trip, pairs
+    idempotently, and the rollout pointer ships the drafter tag to the
+    replica's set_weights."""
+    engine = _engine(resilience={"async_save": False,
+                                 "preemption_guard": False})
+    engine.train_batch(batch=_batch(0))
+    engine.save_checkpoint(str(tmp_path))        # global_step1 (drafter)
+    engine.train_batch(batch=_batch(1))
+    engine.save_checkpoint(str(tmp_path))        # global_step2 (target)
+
+    reg = VersionRegistry(str(tmp_path))
+    v1 = reg.publish("global_step2", drafter="global_step1")
+    assert v1.drafter == "global_step1"
+    # idempotent for the SAME pair...
+    assert reg.publish("global_step2",
+                       drafter="global_step1").version == v1.version
+    # ...but a different drafter for the same target is a NEW routable
+    # unit (acceptance-rate comparability pins the pair, not the target)
+    v2 = reg.publish("global_step2", drafter=None)
+    assert v2.version == v1.version + 1 and v2.drafter is None
+
+    # serde: the pair survives VERSIONS.json
+    fresh = VersionRegistry(str(tmp_path))
+    assert {v.version: v.drafter for v in fresh.list()} == \
+        {v1.version: "global_step1", v2.version: None}
+
+    # an uncommitted drafter tag is rejected exactly like a torn target
+    with pytest.raises(ValueError, match="drafter tag"):
+        reg.publish("global_step2", drafter="global_step99")
+
+    # the rollout pointer ships both tags
+    from deeperspeed_tpu.lifecycle.controller import RolloutDriver
+    drv = RolloutDriver(router=None, registry=reg)
+    ptr = drv._checkpoint_pointer(v1)
+    assert ptr["tag"] == "global_step2"
+    assert ptr["drafter_tag"] == "global_step1"
+    assert "drafter_tag" not in drv._checkpoint_pointer(v2)
+
+    # publisher-side: an armed drafter_tag rides every publish
+    from deeperspeed_tpu.lifecycle.controller import VersionPublisher
+    engine.train_batch(batch=_batch(2))
+    engine.save_checkpoint(str(tmp_path))        # global_step3
+    pub = VersionPublisher(str(tmp_path), registry=reg)
+    pub.drafter_tag = "global_step1"
+    rec = pub.poll()
+    assert rec is not None and rec.tag == "global_step3"
+    assert rec.drafter == "global_step1"
+
+
 def test_publisher_autowires_and_publishes_on_save(tmp_path):
     """An engine with resilience + lifecycle blocks publishes every
     committed interval autosave with no extra wiring."""

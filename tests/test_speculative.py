@@ -15,7 +15,10 @@ import pytest
 
 from deeperspeed_tpu.models.generation import make_generator
 from deeperspeed_tpu.models.gpt import GPTConfig, make_gpt
-from deeperspeed_tpu.models.speculative import make_speculative_generator
+from deeperspeed_tpu.models.speculative import (
+    make_matched_speculative_generator,
+    make_speculative_generator,
+)
 
 
 def _cfg(n_layer, d_model=32, vocab=97, rotary=True):
@@ -207,3 +210,83 @@ class TestBatchedDecoding:
         gen = make_speculative_generator(tcfg, dcfg, k_draft=2)
         with pytest.raises(ValueError, match="rng"):
             gen(tparams, dparams, prompt, max_new_tokens=4, temperature=0.9)
+
+
+class TestMatchedKeyVerification:
+    """make_matched_speculative_generator: the SERVING ENGINE's
+    determinism contract in generator form. Draft and target both draw
+    with engine_sample_key(seed, output_index); a draft is accepted iff
+    it equals the target's own draw — so the output is EXACTLY the
+    per-token decode stream for ANY drafter, greedy or sampled (unlike
+    Leviathan rejection sampling, which preserves the distribution but
+    not the realized tokens under a weak draft)."""
+
+    def _reference_engine_sampling(self, cfg, params, prompt, max_new,
+                                   temperature, seeds):
+        """Plain per-token decode with the engine's key discipline."""
+        from deeperspeed_tpu.models.generation import (
+            apply_with_cache, init_cache)
+        from deeperspeed_tpu.models.speculative import (
+            _prep_logits, engine_sample_key)
+
+        B, S = prompt.shape
+
+        def draw(logits_last, i):
+            prepped = _prep_logits(logits_last, temperature, None)
+            return jnp.stack([
+                jax.random.categorical(
+                    engine_sample_key(seeds[b], i), prepped[b], axis=-1)
+                for b in range(B)]).astype(jnp.int32)
+
+        cache = init_cache(cfg, B, S + max_new)
+        logits, cache = apply_with_cache(cfg, params, prompt, cache, 0)
+        tok = draw(logits[:, -1], 0)
+        toks = [tok]
+        for m in range(1, max_new):
+            logits, cache = apply_with_cache(
+                cfg, params, tok[:, None], cache, S + m - 1)
+            tok = draw(logits[:, -1], m)
+            toks.append(tok)
+        return jnp.concatenate([prompt, jnp.stack(toks, axis=1)], axis=1)
+
+    def test_greedy_matches_plain_greedy_weak_draft(self, models):
+        tcfg, tparams, dcfg, dparams = models
+        prompt = jnp.asarray([[5, 17, 3]], jnp.int32)
+        ref = make_generator(tcfg)(tparams, prompt, max_new_tokens=21)
+        spec = make_matched_speculative_generator(tcfg, dcfg, k_draft=4)(
+            tparams, dparams, prompt, max_new_tokens=21)
+        np.testing.assert_array_equal(np.asarray(ref), np.asarray(spec))
+
+    def test_sampled_matches_per_token_decode_weak_draft(self, models):
+        """The contract Leviathan can NOT give: token identity under
+        sampling with an unrelated draft."""
+        tcfg, tparams, dcfg, dparams = models
+        prompt = jnp.asarray([[3, 1, 4], [1, 5, 9]], jnp.int32)
+        seeds = jnp.asarray([7, 1234], jnp.int32)
+        ref = self._reference_engine_sampling(
+            tcfg, tparams, prompt, 17, 0.9, seeds)
+        spec = make_matched_speculative_generator(tcfg, dcfg, k_draft=3)(
+            tparams, dparams, prompt, max_new_tokens=17,
+            temperature=0.9, seeds=seeds)
+        np.testing.assert_array_equal(np.asarray(ref), np.asarray(spec))
+
+    def test_sampled_matches_per_token_decode_perfect_draft(self, models):
+        tcfg, tparams, _, _ = models
+        prompt = jnp.asarray([[9, 8, 7]], jnp.int32)
+        seeds = jnp.asarray([42], jnp.int32)
+        ref = self._reference_engine_sampling(
+            tcfg, tparams, prompt, 14, 1.0, seeds)
+        spec = make_matched_speculative_generator(tcfg, tcfg, k_draft=3)(
+            tparams, tparams, prompt, max_new_tokens=14,
+            temperature=1.0, seeds=seeds)
+        np.testing.assert_array_equal(np.asarray(ref), np.asarray(spec))
+
+    def test_engine_key_contract_is_the_single_definition(self):
+        """serving/engine.request_sample_key must BE
+        models/speculative.engine_sample_key — the fleet's retry and
+        mixed-replica identity hangs on the two never diverging."""
+        from deeperspeed_tpu.models.speculative import engine_sample_key
+        from deeperspeed_tpu.serving.engine import request_sample_key
+        k1 = request_sample_key(jnp.int32(123), jnp.int32(7))
+        k2 = engine_sample_key(jnp.int32(123), jnp.int32(7))
+        np.testing.assert_array_equal(np.asarray(k1), np.asarray(k2))

@@ -178,6 +178,44 @@ def test_serving_feasible_prefers_bigger_pool():
     assert ranked[0].detail["serving"]["num_blocks"] == 132
 
 
+def test_serving_spec_variants_enumerate_and_price():
+    """draft_ks adds speculative candidates alongside each plain one:
+    the block carries the sub-config, the name says so, and the drafter
+    KV pool + drafter params are priced into the HBM need."""
+    # an 8-layer target with a 1-layer drafter: the regime speculation
+    # is FOR (with TINY's 2 layers a half-depth drafter never pays,
+    # and the cost model correctly says so)
+    deep = ModelSpec(n_layer=8)
+    cands = enumerate_serving_buckets(deep, num_slots=8, max_seq_len=64,
+                                      block_sizes=(16,),
+                                      pool_doublings=0, draft_ks=(0, 4),
+                                      drafter_layers=1)
+    assert len(cands) == 2
+    plain, spec = cands
+    assert "speculative" not in plain.block
+    assert spec.block["speculative"] == {"draft_k": 4,
+                                         "drafter": {"n_layer": 1}}
+    assert spec.name.endswith("_spec4")
+    # drafter pool rides the same bytes formula, layers = n_layer + 1
+    assert spec.kv_pool_bytes == plain.kv_pool_bytes * \
+        (deep.n_layer + 1) / deep.n_layer
+
+    budget = platform_budget()
+    p_plain = price_serving(plain, deep, budget, accept_rate=0.7)
+    p_spec = price_serving(spec, deep, budget, accept_rate=0.7)
+    assert p_spec.detail["drafter_param_bytes"] > 0
+    assert p_plain.components["decode_cost"] == 1.0
+    # a decent drafter at 0.7 acceptance buys back more decode steps
+    # than its own rounds cost...
+    assert p_spec.components["spec_speedup"] > 1.0
+    assert p_spec.predicted_step_s < p_plain.predicted_step_s
+    # ...and a drafter that never lands is pure overhead: the cost
+    # model must NOT recommend speculation at zero acceptance
+    p_cold = price_serving(spec, deep, budget, accept_rate=0.0)
+    assert p_cold.components["spec_speedup"] < 1.0
+    assert p_cold.predicted_step_s > p_plain.predicted_step_s
+
+
 def test_rank_candidates_rejects_unreasoned_pruning():
     from deeperspeed_tpu.autotune.costmodel import CandidatePrice
     bogus = CandidatePrice(name="x", kind="layout", feasible=False, reason="")
