@@ -220,7 +220,7 @@ def enumerate_mesh_layouts(
 
 def enumerate_comm_variants(
     *,
-    modes: Sequence[str] = ("fp32", "bf16", "int8"),
+    modes: Sequence[str] = ("fp32", "bf16", "int8", "lossless"),
     bucket_mbs: Sequence[float] = (0.05, 1.0, 25.0),
     overlaps: Sequence[str] = ("off",),
     include_none: bool = True,

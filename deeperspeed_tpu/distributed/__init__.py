@@ -1,0 +1,44 @@
+"""Multi-host runtime: process-spanning meshes over ``jax.distributed``.
+
+The pieces, bottom-up:
+
+* :mod:`.config` — the validated ``"distributed"`` config block
+  (coordinator address, process shape, timeouts, CPU collectives).
+* :mod:`.bootstrap` — ``bootstrap()``: idempotent ``jax.distributed``
+  init with retry/backoff and heartbeat mapping, per-host run-context
+  roles, the localhost multiprocess capability probe.
+* :mod:`.topology` — pure reads over device→process placement
+  (``derive_intra_size``, ``intra_inter_split``, ``describe``).
+* :mod:`.rendezvous` — atomic per-host records + the clock handshake.
+* :mod:`.fleet` — the N-process supervisor: coordinated restart
+  barrier and cross-host pool growth.
+
+Submodules load lazily: the comm reducer imports ``.topology`` on its
+hot path and must not drag ``.fleet``'s subprocess machinery (or jax
+itself) in with it.
+"""
+
+import importlib
+
+__all__ = [
+    "DistributedConfig",
+    "bootstrap",
+    "config",
+    "fleet",
+    "rendezvous",
+    "topology",
+]
+
+_SUBMODULES = ("bootstrap", "config", "fleet", "rendezvous", "topology")
+
+
+def __getattr__(name):
+    if name in _SUBMODULES:
+        return importlib.import_module(f".{name}", __name__)
+    if name == "DistributedConfig":
+        return importlib.import_module(".config", __name__).DistributedConfig
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(__all__)

@@ -1,0 +1,412 @@
+"""Multi-host bootstrap: bring up ``jax.distributed`` under policy.
+
+:func:`bootstrap` is the one place the runtime crosses from "a process"
+to "process k of N": it resolves the fleet shape (config pins or the
+launcher/MPI environment), applies the CPU-collectives backend and the
+simulated-device count *before* jax initializes its backend, runs
+``jax.distributed.initialize`` with retry + exponential backoff around
+the configured init/heartbeat timeouts, stamps the per-host run context
+(``role.h<proc>`` — every host gets its own obs files), writes this
+host's rendezvous record, and emits a ``dist/init`` trace span carrying
+the resulting process topology.
+
+The jaxlib build's CPU platform ships with cross-process collectives
+DISABLED (``jax_cpu_collectives_implementation`` defaults to none): a
+2-process CPU mesh would rendezvous fine and then fail on the first
+``psum``. ``cpu_collectives: "auto"`` flips it to gloo whenever the run
+spans processes on CPU — which is precisely what makes every multi-host
+drill in this repo runnable on localhost.
+
+Idempotent: a second call (engine re-init inside one process, the
+legacy :func:`...utils.distributed.init_distributed` path having run
+first) returns the existing topology.
+"""
+
+import dataclasses
+import os
+import sys
+import time
+from typing import Dict, Optional
+
+from ..utils.logging import logger
+from .config import DistributedConfig
+
+__all__ = [
+    "ProcessTopology",
+    "bootstrap",
+    "current_topology",
+    "initialize_jax_distributed",
+    "multiprocess_cpu_probe",
+    "shutdown",
+]
+
+_XLA_DEVCOUNT_FLAG = "--xla_force_host_platform_device_count"
+
+_state: Dict[str, object] = {"initialized": False, "topology": None}
+
+
+@dataclasses.dataclass(frozen=True)
+class ProcessTopology:
+    """What :func:`bootstrap` established — one record per process."""
+
+    process_id: int
+    process_count: int
+    local_devices: int
+    global_devices: int
+    coordinator_address: Optional[str] = None
+    cpu_collectives: str = "off"
+
+    @property
+    def multihost(self) -> bool:
+        return self.process_count > 1
+
+    def host_role(self, base: str) -> str:
+        """Per-host role label: ``trainer`` -> ``trainer.h1`` so each
+        host's obs files (``<role>.i<inc>.trace.json``) are distinct."""
+        from ..monitor.runctx import host_role
+
+        return host_role(base, self.process_id, self.process_count)
+
+    def as_args(self) -> Dict[str, object]:
+        return {
+            "process": self.process_id,
+            "processes": self.process_count,
+            "local_devices": self.local_devices,
+            "global_devices": self.global_devices,
+        }
+
+
+def _apply_local_devices(n: Optional[int]) -> None:
+    """Pin the simulated CPU device count (drills). Must land before
+    jax builds its backend; warns instead of lying when it can't."""
+    if n is None:
+        return
+    flags = os.environ.get("XLA_FLAGS", "")
+    if _XLA_DEVCOUNT_FLAG in flags:
+        return  # launcher/conftest already pinned it; theirs wins
+    if "jax" in sys.modules:
+        # merely-imported jax is fine (XLA reads XLA_FLAGS at backend
+        # creation); an already-built backend is not. The probe must
+        # NOT be jax.local_device_count() — that call would itself
+        # build the backend it is checking for.
+        import jax
+
+        try:
+            from jax._src import xla_bridge as _xb
+
+            backend_up = _xb.backends_are_initialized()
+        except ImportError:  # pragma: no cover - layout drift
+            backend_up = False
+        if backend_up:
+            have = jax.local_device_count()
+            if have != int(n):
+                logger.warning(
+                    "distributed.local_devices=%s requested but jax "
+                    "already initialized %s local devices; flag ignored "
+                    "(set XLA_FLAGS before the first jax computation)",
+                    n, have)
+            return
+    os.environ["XLA_FLAGS"] = (
+        f"{flags} {_XLA_DEVCOUNT_FLAG}={int(n)}".strip())
+
+
+def _apply_cpu_collectives(choice: str, num_processes: int) -> str:
+    """Select the CPU cross-process collectives backend. Returns the
+    backend applied ("off" = left at the platform default)."""
+    import jax
+
+    if choice == "off" or num_processes <= 1:
+        return "off"
+    if os.environ.get("JAX_PLATFORMS", "").lower() not in ("", "cpu"):
+        if choice in ("gloo", "mpi"):
+            logger.warning(
+                "distributed.cpu_collectives=%s requested on a non-CPU "
+                "platform; ignored", choice)
+        return "off"
+    backend = "gloo" if choice == "auto" else choice
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", backend)
+    except Exception as e:  # unknown option on exotic jaxlib builds
+        logger.warning(
+            "could not enable CPU collectives backend %r (%s); "
+            "cross-process CPU collectives will fail", backend, e)
+        return "off"
+    return backend
+
+
+def initialize_jax_distributed(coordinator_address: str,
+                               num_processes: int, process_id: int,
+                               *, init_timeout_s: float = 120.0,
+                               heartbeat_timeout_s: float = 100.0,
+                               init_retries: int = 3,
+                               retry_backoff_s: float = 1.0) -> None:
+    """``jax.distributed.initialize`` under a retry + backoff policy.
+
+    The heartbeat budget maps onto the coordination service's
+    interval x max-missed knobs (a silent peer is declared dead after
+    ~``heartbeat_timeout_s``); older jax builds without those knobs fall
+    back to the public API and its defaults.
+    """
+    import jax
+    from jax._src import distributed as _jdist
+
+    hb_interval = max(1, int(round(float(heartbeat_timeout_s) / 10.0)))
+    hb_missing = max(2, int(round(float(heartbeat_timeout_s) / hb_interval)))
+    last: Optional[BaseException] = None
+    for attempt in range(1, int(init_retries) + 1):
+        try:
+            try:
+                from jax._src import xla_bridge as _xb
+            except ImportError:  # pragma: no cover - layout drift
+                _xb = None
+            if (_xb is not None
+                    and _xb.backends_are_initialized()):
+                raise RuntimeError(
+                    "jax backend already initialized; bootstrap must "
+                    "run before any jax computation")
+            try:
+                _jdist.global_state.initialize(
+                    coordinator_address=coordinator_address,
+                    num_processes=int(num_processes),
+                    process_id=int(process_id),
+                    initialization_timeout=int(init_timeout_s),
+                    service_heartbeat_interval_seconds=hb_interval,
+                    service_max_missing_heartbeats=hb_missing,
+                    client_heartbeat_interval_seconds=hb_interval,
+                    client_max_missing_heartbeats=hb_missing,
+                )
+            except TypeError:
+                # jax build without heartbeat knobs: public API
+                jax.distributed.initialize(
+                    coordinator_address=coordinator_address,
+                    num_processes=int(num_processes),
+                    process_id=int(process_id),
+                    initialization_timeout=int(init_timeout_s),
+                )
+            return
+        except RuntimeError:
+            raise  # double init / backend-already-up: retrying can't help
+        except Exception as e:  # transient: coordinator not up yet, etc.
+            last = e
+            if attempt >= int(init_retries):
+                break
+            delay = float(retry_backoff_s) * (2.0 ** (attempt - 1))
+            logger.warning(
+                "jax.distributed.initialize attempt %d/%d failed (%s); "
+                "retrying in %.1fs", attempt, init_retries, e, delay)
+            time.sleep(delay)
+    raise RuntimeError(
+        f"jax.distributed.initialize failed after {init_retries} "
+        f"attempt(s): {last}") from last
+
+
+def _distributed_client_up() -> bool:
+    """Is the jax.distributed client already connected in this process?"""
+    try:
+        from jax._src import distributed as _jdist
+
+        return _jdist.global_state.client is not None
+    except Exception:
+        return False
+
+
+def current_topology() -> Optional[ProcessTopology]:
+    """The topology :func:`bootstrap` established, or None."""
+    return _state["topology"]  # type: ignore[return-value]
+
+
+def bootstrap(cfg: Optional[DistributedConfig] = None,
+              *, role: Optional[str] = None) -> ProcessTopology:
+    """Establish the process topology for this run.
+
+    Single-process (no fleet shape anywhere) is not an error — the
+    returned topology simply has ``process_count == 1`` and nothing was
+    initialized, so every config works unchanged on a laptop.
+    """
+    if _state["initialized"]:
+        return _state["topology"]  # type: ignore[return-value]
+    cfg = cfg or DistributedConfig()
+    if not cfg.enabled:
+        raise ValueError("bootstrap() called with a disabled config")
+
+    shape = None
+    if cfg.num_processes is not None:
+        addr = cfg.coordinator_address
+        if addr is None:
+            raise ValueError(
+                "distributed.num_processes pinned without "
+                "coordinator_address (and no launcher environment)")
+        shape = dict(coordinator_address=addr,
+                     num_processes=int(cfg.num_processes),
+                     process_id=int(cfg.process_id))
+    else:
+        from ..utils import distributed as _legacy
+
+        shape = _legacy.discover()
+        if shape is not None and cfg.coordinator_address is not None:
+            shape["coordinator_address"] = cfg.coordinator_address
+
+    _apply_local_devices(cfg.local_devices)
+
+    if shape is None or int(shape["num_processes"]) <= 1:
+        import jax
+
+        topo = ProcessTopology(
+            process_id=0, process_count=1,
+            local_devices=int(jax.local_device_count()),
+            global_devices=int(jax.device_count()),
+            coordinator_address=None, cpu_collectives="off")
+        _state.update(initialized=True, topology=topo)
+        return topo
+
+    import jax
+
+    from ..utils import distributed as _legacy
+
+    if _legacy._initialized or _distributed_client_up():
+        # the legacy init_distributed path (or an embedding application)
+        # already brought jax.distributed up; adopt its topology
+        backend = "external"
+    else:
+        backend = _apply_cpu_collectives(
+            cfg.cpu_collectives, int(shape["num_processes"]))
+        initialize_jax_distributed(
+            shape["coordinator_address"], int(shape["num_processes"]),
+            int(shape["process_id"]),
+            init_timeout_s=cfg.init_timeout_s,
+            heartbeat_timeout_s=cfg.heartbeat_timeout_s,
+            init_retries=cfg.init_retries,
+            retry_backoff_s=cfg.retry_backoff_s)
+        # mark the legacy entry point initialized too — both guards
+        # protect the same jax.distributed singleton
+        _legacy._initialized = True
+
+    topo = ProcessTopology(
+        process_id=int(jax.process_index()),
+        process_count=int(jax.process_count()),
+        local_devices=int(jax.local_device_count()),
+        global_devices=int(jax.device_count()),
+        coordinator_address=str(shape["coordinator_address"]),
+        cpu_collectives=backend)
+    _state.update(initialized=True, topology=topo)
+
+    # per-host run context: every process of the fleet keeps the run id
+    # but gets its own role lane (trainer.h0, trainer.h1, ...)
+    from ..monitor import runctx
+
+    base_role = role or os.environ.get(runctx.ROLE_ENV, "trainer")
+    os.environ[runctx.ROLE_ENV] = runctx.host_role(
+        base_role, topo.process_id, topo.process_count)
+
+    # the fleet supervisor hands children the record directory via env;
+    # a config pin wins when both are present
+    rdzv_dir = cfg.rendezvous_dir or os.environ.get("DS_TPU_RENDEZVOUS_DIR")
+    if rdzv_dir:
+        from . import rendezvous
+
+        rendezvous.write_record(
+            rdzv_dir,
+            rendezvous.HostRecord(
+                host=topo.process_id, pid=os.getpid(),
+                incarnation=runctx.current().incarnation,
+                epoch=int(os.environ.get("DS_TPU_FLEET_EPOCH", "0")),
+                role=os.environ[runctx.ROLE_ENV], status="ready",
+                clock=runctx.clock_anchor()))
+
+    from ..monitor import trace_span
+
+    with trace_span("dist/init", lane="dist",
+                    coordinator=topo.coordinator_address,
+                    cpu_collectives=backend, **topo.as_args()):
+        pass
+    logger.info(
+        "distributed bootstrap: process %d/%d, %d local / %d global "
+        "devices, coordinator=%s, cpu_collectives=%s",
+        topo.process_id, topo.process_count, topo.local_devices,
+        topo.global_devices, topo.coordinator_address, backend)
+    return topo
+
+
+def shutdown() -> None:
+    """Tear down jax.distributed (subprocess drills/tests)."""
+    if not _state["initialized"]:
+        return
+    import jax
+
+    try:
+        jax.distributed.shutdown()
+    except Exception:
+        pass
+    _state.update(initialized=False, topology=None)
+
+
+# ---------------------------------------------------------------------- #
+# capability probe
+# ---------------------------------------------------------------------- #
+
+_PROBE_CHILD = r"""
+import os, sys
+rank = int(sys.argv[1]); port = sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("XLA_FLAGS", None)
+import jax
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+jax.distributed.initialize(f"127.0.0.1:{port}", 2, rank,
+                           initialization_timeout=30)
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+import numpy as np
+mesh = Mesh(np.asarray(jax.devices()), ("d",))
+x = jax.make_array_from_process_local_data(
+    NamedSharding(mesh, P("d")), np.full((1,), rank + 1, np.float32))
+total = jax.jit(lambda a: jnp.sum(a), out_shardings=NamedSharding(mesh, P()))(x)
+assert float(total) == 3.0, float(total)
+print("PROBE-OK", flush=True)
+"""
+
+_probe_cache: Dict[str, bool] = {}
+
+
+def multiprocess_cpu_probe(timeout_s: float = 90.0) -> bool:
+    """Can THIS jaxlib build run 2-process CPU collectives on localhost?
+
+    Spawns two throwaway processes that rendezvous on a free port and
+    psum across the process boundary via gloo. Cached per process; the
+    multiprocess tests and the check.sh smoke hang their skip condition
+    on this instead of a hardcoded assumption about the build.
+    """
+    if "ok" in _probe_cache:
+        return _probe_cache["ok"]
+    import socket
+    import subprocess
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _PROBE_CHILD, str(r), str(port)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        for r in (0, 1)
+    ]
+    ok = True
+    deadline = time.monotonic() + timeout_s
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=max(1.0,
+                                               deadline - time.monotonic()))
+            ok = ok and p.returncode == 0 and "PROBE-OK" in out
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.communicate()
+            ok = False
+    _probe_cache["ok"] = ok
+    return ok
+
+
+if __name__ == "__main__":
+    sys.exit(0 if multiprocess_cpu_probe() else 1)

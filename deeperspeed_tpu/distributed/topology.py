@@ -1,0 +1,129 @@
+"""Process topology: which devices live on which host.
+
+The comm layer's hierarchical two-level schedule and the mesh factory
+both need one fact the flat device list hides: the partition of the
+global device set into *processes* (hosts). Every helper here reads it
+from ``device.process_index`` — the source of truth jax maintains once
+``jax.distributed`` is initialized — so the answers stay correct on
+single-process simulated meshes (one process owning every device) and
+on real process-spanning fleets alike.
+
+Pure reads over jax device metadata; no collectives, no config.
+"""
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "process_count",
+    "local_device_count",
+    "process_groups",
+    "is_process_spanning",
+    "derive_intra_size",
+    "describe",
+    "intra_inter_split",
+]
+
+
+def _mesh_axis_devices(mesh, axes: Sequence[str]):
+    """Flatten a mesh's device array so the reduction ``axes`` vary
+    fastest-last in rank order — the order ``axis_index_groups`` address
+    (rank r = position in the axis-major enumeration)."""
+    import numpy as np
+
+    names = list(mesh.axis_names)
+    order = ([n for n in names if n not in axes]
+             + [n for n in names if n in axes])
+    perm = [names.index(n) for n in order]
+    return np.transpose(mesh.devices, perm).reshape(-1)
+
+
+def process_count() -> int:
+    import jax
+
+    return int(jax.process_count())
+
+
+def local_device_count() -> int:
+    import jax
+
+    return int(jax.local_device_count())
+
+
+def process_groups(devices=None) -> Dict[int, List[int]]:
+    """``{process_index: [global device ids]}`` for ``devices``
+    (default: the global device list), ids in ``jax.devices()`` order."""
+    import jax
+
+    devs = list(jax.devices()) if devices is None else list(devices)
+    groups: Dict[int, List[int]] = {}
+    for i, d in enumerate(devs):
+        groups.setdefault(int(d.process_index), []).append(i)
+    return groups
+
+
+def is_process_spanning(mesh) -> bool:
+    """Does this mesh place shards on more than one process?"""
+    return len({int(d.process_index)
+                for d in mesh.devices.reshape(-1)}) > 1
+
+
+def derive_intra_size(mesh, axes: Sequence[str]) -> Optional[int]:
+    """The in-host group size for a hierarchical reduction over
+    ``axes`` — the count of consecutive same-process ranks along the
+    reduction order — or None when host boundaries don't form equal
+    contiguous rank blocks (the hierarchical schedule's
+    ``axis_index_groups`` are contiguous ``[n*k, (n+1)*k)`` blocks, so a
+    straddling layout must fall back to the flat schedule rather than
+    silently put the "intra" hop on the cross-host wire)."""
+    devs = _mesh_axis_devices(mesh, tuple(axes))
+    procs = [int(d.process_index) for d in devs]
+    n = len(procs)
+    if n <= 1 or len(set(procs)) <= 1:
+        return None
+    # run-length check: equal-sized runs, each process exactly one run
+    k = 1
+    while k < n and procs[k] == procs[0]:
+        k += 1
+    if n % k:
+        return None
+    seen = set()
+    for g in range(n // k):
+        block = procs[g * k:(g + 1) * k]
+        if len(set(block)) != 1 or block[0] in seen:
+            return None
+        seen.add(block[0])
+    return k
+
+
+def describe(mesh) -> Dict[str, object]:
+    """JSON-ready process-topology descriptor for a mesh (stamped into
+    ``dist/init`` trace events and BENCH files)."""
+    import jax
+
+    flat = mesh.devices.reshape(-1)
+    per: Dict[int, int] = {}
+    for d in flat:
+        p = int(d.process_index)
+        per[p] = per.get(p, 0) + 1
+    return {
+        "processes": int(jax.process_count()),
+        "process_index": int(jax.process_index()),
+        "devices": int(flat.size),
+        "local_devices": int(jax.local_device_count()),
+        "devices_per_process": {str(k): v for k, v in sorted(per.items())},
+        "process_spanning": len(per) > 1,
+    }
+
+
+def intra_inter_split(world: int, k: int) -> Tuple[List[List[int]],
+                                                   List[List[int]]]:
+    """The (intra, inter) ``axis_index_groups`` of the two-level
+    schedule for a world of ``world`` ranks in host blocks of ``k`` —
+    shared by the reducer (which executes them) and the wire model
+    (which prices each hop against its link)."""
+    if world % k:
+        raise ValueError(f"intra size {k} must divide world {world}")
+    nn = world // k
+    intra = [[n * k + i for i in range(k)] for n in range(nn)]
+    inter = [[n * k + i for n in range(nn)] for i in range(k)]
+    return intra, inter

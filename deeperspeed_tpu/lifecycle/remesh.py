@@ -18,8 +18,13 @@ block; a bare training loop can call ``hook.poll(engine)`` itself.
 
 A pool *grow* beyond the process's device count cannot happen live (the
 JAX device list is fixed at process start) — ``choose_world`` caps at
-``len(jax.devices())`` and the supervisor's relaunch path still owns
-growth.
+``len(jax.devices())``. Growth past that cap means adding *processes*,
+which is the fleet supervisor's coordinated-restart path
+(:class:`...distributed.fleet.FleetSupervisor` watching a pool file
+that holds the PROCESS count): every host relaunches together at the
+new process count and ``resilience/reshard.py`` carries residual state
+across the world-size change. :func:`cross_host_growth_needed` is the
+predicate both sides share.
 """
 
 import os
@@ -31,7 +36,15 @@ from ..resilience.supervisor import POOL_FILE_ENV
 from ..utils.logging import logger
 from .config import LifecycleConfig
 
-__all__ = ["RemeshHook"]
+__all__ = ["RemeshHook", "cross_host_growth_needed"]
+
+
+def cross_host_growth_needed(pool: Optional[int],
+                             device_cap: int) -> bool:
+    """True when a pool target exceeds what THIS process can re-mesh to
+    live — the point where elasticity must switch from the in-process
+    flip to the fleet supervisor's coordinated process-count restart."""
+    return pool is not None and int(pool) > int(device_cap)
 
 
 class RemeshHook:
@@ -118,6 +131,12 @@ class RemeshHook:
             return None
         cap = len(jax.devices())
         pool = self.read_pool()
+        if cross_host_growth_needed(pool, cap):
+            logger.info(
+                "lifecycle: pool target %s exceeds this process's %d "
+                "device(s) — growth past the cap needs new PROCESSES "
+                "(distributed.fleet coordinated restart); re-meshing "
+                "to the in-process cap", pool, cap)
         if pool is not None:
             cap = min(cap, pool)
         admissible = [s for s in sizes if s <= cap]

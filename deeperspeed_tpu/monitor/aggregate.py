@@ -33,10 +33,16 @@ Library surface: ``merge_files(paths, ...) -> (doc, stats)``. CLI::
     python -m deeperspeed_tpu.monitor.aggregate --out merged.json \
         router.trace.json replica-r1.i0.flight.bin replica-r0.i0.trace.json
 
-Sources are auto-detected (flight magic vs JSON). ``--strict`` runs the
-schema validator in strict mode on the merged result and exits non-zero
-on problems; ``--offsets offsets.json`` maps source basenames to
-handshake-measured clock offsets in seconds.
+Sources are auto-detected (flight magic vs JSON). A source that is a
+DIRECTORY expands to every ``*.trace.json`` / ``*.flight.bin`` inside
+it — the multi-host shape, where each host's ``trainer.h<k>`` role
+writes its own obs files into one shared directory — and an
+``offsets.json`` sidecar in that directory (the fleet supervisor's
+clock-offset ledger, keyed by host role) is applied automatically.
+``--strict`` runs the schema validator in strict mode on the merged
+result and exits non-zero on problems; ``--offsets offsets.json`` maps
+source basenames OR host roles to handshake-measured clock offsets in
+seconds (explicit values win over directory sidecars).
 """
 
 import argparse
@@ -48,7 +54,45 @@ from typing import Dict, List, Optional, Tuple
 from . import flight as flight_mod
 from .validate import validate_events
 
-__all__ = ["load_source", "merge_sources", "merge_files", "main"]
+__all__ = ["expand_sources", "load_source", "merge_sources",
+           "merge_files", "main"]
+
+OFFSETS_SIDECAR = "offsets.json"
+
+
+def expand_sources(paths: List[str]) -> List[str]:
+    """Expand directory sources into their obs files, sorted by name so
+    per-host lanes come out in host order. Non-directories pass through
+    unchanged (missing files fail later, loudly, in load_source)."""
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            names = sorted(os.listdir(p))
+            out.extend(os.path.join(p, n) for n in names
+                       if n.endswith(".trace.json")
+                       or n.endswith(".flight.bin"))
+        else:
+            out.append(p)
+    return out
+
+
+def _sidecar_offsets(paths: List[str]) -> Dict[str, float]:
+    """Clock offsets from offsets.json sidecars of directory sources
+    (the fleet supervisor's handshake ledger, keyed by host role)."""
+    out: Dict[str, float] = {}
+    for p in paths:
+        if not os.path.isdir(p):
+            continue
+        sidecar = os.path.join(p, OFFSETS_SIDECAR)
+        try:
+            with open(sidecar) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        for k, v in doc.items():
+            if isinstance(v, (int, float)):
+                out[str(k)] = float(v)
+    return out
 
 # events on these names seed flow arrows: dispatch is the source side,
 # admit the target side, matched per (rid, attempt) ordering
@@ -232,12 +276,21 @@ def merge_files(paths: List[str], out: Optional[str] = None,
                 offsets_s: Optional[Dict[str, float]] = None,
                 ) -> Tuple[dict, dict]:
     """Load, align, merge, and optionally write. ``offsets_s`` maps a
-    source basename to its handshake-measured wall-clock offset in
-    seconds (how far that host's clock runs ahead)."""
-    sources = [load_source(p) for p in paths]
+    source basename OR its run-context role to its handshake-measured
+    wall-clock offset in seconds (how far that host's clock runs
+    ahead). Directory entries in ``paths`` expand to their obs files,
+    and their offsets.json sidecars merge in under explicit values."""
+    offsets = _sidecar_offsets(paths)
+    offsets.update(offsets_s or {})
+    sources = [load_source(p) for p in expand_sources(paths)]
     for src in sources:
-        if offsets_s:
-            off = offsets_s.get(os.path.basename(src.path))
+        if offsets:
+            off = offsets.get(os.path.basename(src.path))
+            if off is None:
+                # multi-host ledgers key by role (trainer.h1), which
+                # survives the per-incarnation file renames
+                role = (src.run or {}).get("role")
+                off = offsets.get(str(role or ""))
             if off is not None:
                 # the source's clock runs `off` ahead: subtract to land
                 # its events on the reference timeline
@@ -259,11 +312,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "flight snapshots into one aligned timeline.")
     ap.add_argument("sources", nargs="+",
                     help="trace JSON and/or flight.bin files "
-                         "(auto-detected)")
+                         "(auto-detected), or obs directories that "
+                         "expand to every trace/flight file inside")
     ap.add_argument("--out", required=True, help="merged trace path")
     ap.add_argument("--offsets", default=None, metavar="JSON",
-                    help="file mapping source basename -> clock offset "
-                         "seconds (from the fleet clock handshake)")
+                    help="file mapping source basename or host role -> "
+                         "clock offset seconds (from the fleet clock "
+                         "handshake)")
     ap.add_argument("--strict", action="store_true",
                     help="validate the merged trace in strict mode; "
                          "non-zero exit on problems")

@@ -225,6 +225,30 @@ METRIC_SPECS: Tuple[MetricSpec, ...] = (
     MetricSpec("autotune.best_predicted_cost", "BENCH_autotune.json",
                ("best", "predicted_step_s"), "lower", 1.00,
                note="cpu-nominal roofline seconds: wide band"),
+    # distributed (PR 20): the multi-host fleet drill. Cross-process
+    # loss parity is an exactness gate (the canonical-slot reduction
+    # must be independent of the device->process mapping AND the world
+    # size); the SIGKILL->recovery wall time is CPU wall clock (two
+    # jax.distributed rendezvous + recompile) and gets a wide band;
+    # the cross-host wire bytes of the hierarchical int8 schedule are
+    # a structural count priced by wiremodel.py
+    MetricSpec("multihost.max_loss_delta", "BENCH_multihost.json",
+               ("parity", "max_loss_delta"), "lower", 0.0, 1e-9,
+               note="2-process fleet (and the grown 3-process fleet) "
+                    "must match the single-process mesh bit-for-bit"),
+    MetricSpec("multihost.crash_restarts_after_growth",
+               "BENCH_multihost.json",
+               ("growth", "crash_restarts_after_growth"), "lower", 0.0,
+               note="pool growth is a planned re-mesh, never a crash "
+                    "restart"),
+    MetricSpec("multihost.restart_s", "BENCH_multihost.json",
+               ("restart", "restart_s"), "lower", 1.00, 30.0,
+               note="SIGKILL -> first post-barrier step: cpu wall "
+                    "clock, wide band"),
+    MetricSpec("multihost.int8_inter_bytes", "BENCH_multihost.json",
+               ("wire", "int8", "inter_bytes"), "lower", 0.0,
+               note="cross-host hop of the two-level int8 schedule "
+                    "(wiremodel pricing, exact)"),
 )
 
 _SPECS_BY_NAME = {s.name: s for s in METRIC_SPECS}

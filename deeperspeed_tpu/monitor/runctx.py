@@ -42,6 +42,7 @@ __all__ = [
     "current",
     "ensure_run_id",
     "child_env",
+    "host_role",
     "clock_anchor",
     "estimate_clock_offset",
 ]
@@ -95,6 +96,17 @@ def child_env(role: str, incarnation: int,
     env[ROLE_ENV] = role
     env[INCARNATION_ENV] = str(int(incarnation))
     return env
+
+
+def host_role(base: str, process_id: int, process_count: int) -> str:
+    """The per-host role lane of a multi-process run: ``base.h<proc>``
+    when the run spans processes, ``base`` unchanged when it doesn't.
+    Because obs files are named ``<role>.i<inc>.*``, this suffix is what
+    gives every host its own trace/flight files with zero plumbing —
+    and the aggregator's offsets sidecar keys on the same string."""
+    if int(process_count) <= 1:
+        return base
+    return f"{base}.h{int(process_id)}"
 
 
 def clock_anchor() -> Dict[str, float]:

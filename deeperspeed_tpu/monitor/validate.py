@@ -110,6 +110,14 @@ EVENT_ARG_SCHEMAS = {
     "spec/draft": ("n_active", "k", "dur_us"),
     "spec/verify": ("n_active", "k", "dur_us"),
     "spec/accept": ("rid", "accepted", "k", "emitted"),
+    # multi-host runtime (distributed/): every process stamps its
+    # topology at jax.distributed init (the merged fleet timeline and
+    # BENCH_multihost join per-host lanes on these). Fleet-side
+    # coordination — rendezvous, restart barriers, pool growth — is
+    # recorded in the supervisor's restart JSONL and the rendezvous
+    # records, not as trace events (the supervisor owns no trace lane)
+    "dist/init": ("process", "processes", "local_devices",
+                  "global_devices"),
 }
 
 # strict-mode name discipline: one prefix per subsystem that emits
@@ -118,7 +126,7 @@ KNOWN_EVENT_PREFIXES = (
     "engine/", "pipe/", "offload/", "comm/", "kernels/", "datapipe/",
     "resilience/", "serving/", "flight/", "run/", "goodput/", "trace/",
     "perf/", "mem/", "mesh/", "ablation/", "lifecycle/", "req/", "slo/",
-    "kv/", "spec/",
+    "kv/", "spec/", "dist/",
 )
 KNOWN_EVENT_NAMES = frozenset({
     "xla_compile", "recompile!", "process_name", "thread_name",

@@ -9,7 +9,7 @@ monolithic XLA-scheduled reduction at the end of backward.
 ::
 
     "comm": {
-        "mode": "int8",          # fp32 | bf16 | int8 | compressed
+        "mode": "int8",          # fp32 | bf16 | int8 | compressed | lossless
         "bucket_mb": 25,         # flat bucket size bound (layer order)
         "block": 128,            # quantization block (int8/compressed)
         "error_feedback": true,  # persistent residuals for lossy modes
@@ -28,17 +28,30 @@ bf16        ring allreduce bf16          32
 int8        blockwise int8 + scales      ~16.3 (block=128)
 compressed  fp16 mantissa + int8 block   ~48   (24-bit x all_gather)
             exponent (24-bit format)
+lossless    byte-plane gather, exact     32 x W (gather; see below)
+            pairwise-tree rebuild
 ==========  ===========================  ==========================
 
 Lossy modes carry per-device error-feedback residuals in engine state
 (checkpointed) so the quantization error compensates across steps and the
 loss curve tracks fp32.
+
+``lossless`` is the ZipCCL-style formulation: each rank's fp32
+contribution is bitcast into four int8 byte planes (sign/exponent bytes
+land contiguous, which is what makes the cross-host NIC-side entropy
+coder effective on gradients), the planes ride an ``all_gather``, and
+every rank reassembles the exact fp32 vectors and sums them with the
+graph-fixed pairwise tree. No quantization ever happens, so there are
+no residuals and the result is bit-identical on every world size —
+the multi-host counterpart of the elastic canonical-slot math. Under
+the hierarchical schedule only the *cross-host* hop uses byte planes;
+the in-host hops stay plain fp32 collectives.
 """
 
 import dataclasses
 from typing import Optional
 
-MODES = ("fp32", "bf16", "int8", "compressed")
+MODES = ("fp32", "bf16", "int8", "compressed", "lossless")
 HIERARCHICAL = ("off", "auto", "on")
 OVERLAP = ("off", "auto", "on")
 

@@ -87,6 +87,44 @@ def dequantize_int8_blocks(q, s):
     return (q.astype(jnp.float32) * s[:, None]).reshape(-1)
 
 
+def exact_slot_mean(tree, mesh, axis, canonical):
+    """Layout-invariant mean over the leading (slot) axis of every leaf.
+
+    ``pairwise_slot_sum`` fixes the grouping of adds at the graph level,
+    but inside a jit GSPMD is still free to lower the sliced adds over a
+    *sharded* slot axis into a native all-reduce whose accumulation
+    order depends on the device->process topology (gloo ring vs
+    shared-memory, one ulp apart). This helper pins the data movement:
+    a shard_map all_gathers the raw fp32 slot rows (exact bit transport
+    on any wire) and the pairwise tree then runs *locally* on every
+    device, so the result is bit-identical on any process layout.
+
+    ``tree`` may be a single ``(C, ...)`` array or a pytree of them with
+    the slot axis sharded over ``axis`` (a mesh axis name or tuple).
+    Returns the tree of replicated slot means.
+    """
+    axes = (axis,) if isinstance(axis, str) else tuple(axis)
+    ax = axes[0] if len(axes) == 1 else axes
+    leaves, treedef = jax.tree.flatten(tree)
+    in_specs = tuple(
+        P(ax, *([None] * (l.ndim - 1))) for l in leaves)
+    slot_sh = [NamedSharding(mesh, s) for s in in_specs]
+
+    def body(*ls):
+        outs = []
+        for v in ls:
+            rows = jax.lax.all_gather(v, ax, axis=0, tiled=True)
+            outs.append(pairwise_slot_sum(rows) / canonical)
+        return tuple(outs)
+
+    fn = shard_map(body, mesh=mesh, in_specs=in_specs,
+                   out_specs=tuple(P() for _ in leaves),
+                   **_SHMAP_CHECK_KWARGS)
+    pinned = [jax.lax.with_sharding_constraint(l, s)
+              for l, s in zip(leaves, slot_sh)]
+    return jax.tree.unflatten(treedef, list(fn(*pinned)))
+
+
 def pairwise_slot_sum(x):
     """Graph-fixed pairwise tree sum over the leading (slot) axis.
 
@@ -179,17 +217,29 @@ class GradReducer:
             return None
         if cfg.hierarchical == "auto" and jax.process_count() <= 1:
             return None
-        k = int(cfg.intra_size or jax.local_device_count())
+        k = cfg.intra_size
+        if k is None:
+            # host-topology-aware default: read the in-host group size
+            # off the mesh's device->process placement, so the intra hop
+            # really maps onto in-host links (falls back to
+            # local_device_count for single-process simulated meshes,
+            # where every contiguous k is in-host anyway)
+            from ...distributed import topology as dist_topology
+
+            k = (dist_topology.derive_intra_size(self.mesh, self.axes)
+                 or jax.local_device_count())
+        k = int(k)
         if not (1 < k < self.world) or self.world % k:
             logger.warning(
                 "comm: hierarchical schedule needs 1 < intra_size < world "
                 "with intra_size | world (got intra_size=%d, world=%d); "
                 "falling back to the flat schedule", k, self.world)
             return None
-        if cfg.mode != "int8":
+        if cfg.mode not in ("int8", "lossless"):
             logger.warning(
-                'comm: hierarchical schedule applies to mode "int8" only '
-                '(got "%s"); using the flat schedule', cfg.mode)
+                'comm: hierarchical schedule applies to modes "int8" and '
+                '"lossless" only (got "%s"); using the flat schedule',
+                cfg.mode)
             return None
         return k
 
@@ -221,9 +271,10 @@ class GradReducer:
             # per-SLOT single-phase residuals — C rows regardless of the
             # world size (and even at world == 1, so a single-device
             # checkpoint restores onto a pool bit-for-bit)
-            return {} if self.cfg.mode == "fp32" else {"e": L}
-        if self.world == 1 or self.cfg.mode == "fp32":
-            return {}
+            return ({} if self.cfg.mode in ("fp32", "lossless")
+                    else {"e": L})
+        if self.world == 1 or self.cfg.mode in ("fp32", "lossless"):
+            return {}  # lossless: exact transport, nothing to feed back
         if self.cfg.mode in ("bf16", "compressed"):
             return {"e": L}
         if self.hier_k:  # int8 hierarchical: both phases act on L/k chunks
@@ -301,6 +352,10 @@ class GradReducer:
                          else res["e"]}
         if cfg.mode == "compressed":
             return self._reduce_compressed_flat(v, res)
+        if cfg.mode == "lossless":
+            if self.hier_k:
+                return self._reduce_lossless_hier(v, res)
+            return self._reduce_lossless_flat(v, res)
         if self.hier_k:
             return self._reduce_int8_hier(v, res)
         return self._reduce_int8_flat(v, res)
@@ -401,15 +456,60 @@ class GradReducer:
             interpret=interpret).reshape(-1)
         return out, {"e": new_e, "e2": new_e2}
 
+    @staticmethod
+    def _to_byte_planes(x):
+        """(L,) fp32 -> (4, L) int8 byte planes. Plane-major layout puts
+        every element's sign/exponent byte contiguous on the wire — the
+        layout a ZipCCL-style NIC-side entropy coder compresses well."""
+        return jnp.transpose(jax.lax.bitcast_convert_type(x, jnp.int8),
+                             (1, 0))
+
+    @staticmethod
+    def _from_byte_planes(planes):
+        """(..., 4, L) int8 byte planes -> (..., L) fp32, bit-exact."""
+        perm = tuple(range(planes.ndim - 2)) + (planes.ndim - 1,
+                                                planes.ndim - 2)
+        return jax.lax.bitcast_convert_type(
+            jnp.transpose(planes, perm), jnp.float32)
+
+    def _reduce_lossless_flat(self, v, res):
+        """Lossless byte-plane gather: every rank ships its exact fp32
+        contribution as int8 byte planes, reassembles all W vectors
+        bit-for-bit, and sums them with the graph-fixed pairwise tree —
+        so the mean is both exact (no quantization, no residuals) and
+        bit-identical across world sizes and schedules."""
+        W, ax = self.world, self.axis
+        g = jax.lax.all_gather(self._to_byte_planes(v), ax)  # (W, 4, L)
+        return pairwise_slot_sum(self._from_byte_planes(g)) / W, res
+
+    def _reduce_lossless_hier(self, v, res):
+        """Two-level lossless: intra-host fp32 reduce-scatter (fast
+        links, exact), byte-plane all_gather + pairwise tree across hosts
+        (the compressible cross-host hop), fp32 intra rebuild. Exact end
+        to end; only the wire format of the slow hop changes."""
+        W, ax = self.world, self.axis
+        from ...distributed.topology import intra_inter_split
+
+        intra, inter = intra_inter_split(W, self.hier_k)
+        chunk = jax.lax.psum_scatter(
+            v, ax, scatter_dimension=0, axis_index_groups=intra, tiled=True)
+        g = jax.lax.all_gather(self._to_byte_planes(chunk), ax,
+                               axis_index_groups=inter)  # (nn, 4, L/k)
+        total = pairwise_slot_sum(self._from_byte_planes(g))
+        out = jax.lax.all_gather(total / W, ax, axis_index_groups=intra,
+                                 tiled=True)
+        return out, res
+
     def _reduce_int8_hier(self, v, res):
         """qgZ-style two-level schedule: intra-group reduce-scatter in full
         precision (fast links), int8 all_gather across groups, then an int8
         intra-group rebuild.  Both quantizations carry their own residual."""
+        from ...distributed.topology import intra_inter_split
+
         cfg, W, ax, block = self.cfg, self.world, self.axis, self.cfg.block
         ef = cfg.error_feedback
         k, nn = self.hier_k, self.world // self.hier_k
-        intra = [[n * k + i for i in range(k)] for n in range(nn)]
-        inter = [[n * k + i for n in range(nn)] for i in range(k)]
+        intra, inter = intra_inter_split(W, k)
         chunk = jax.lax.psum_scatter(
             v, ax, scatter_dimension=0, axis_index_groups=intra, tiled=True)
         c1 = chunk + res["e1"] if ef else chunk
@@ -474,6 +574,13 @@ class GradReducer:
             return int(2 * f * 2 * L)
         if mode == "compressed":  # all_gather of (W,nb,block) f16 + (W,nb) s8
             return int(f * (2 * L * W + nb * W))
+        if mode == "lossless":
+            if self.hier_k:
+                k, nn = self.hier_k, W // self.hier_k
+                return int(f * (4 * L // k          # intra RS f32
+                                + nn * 4 * (L // k)  # inter AG byte planes
+                                + 4 * L))            # intra AG f32 rebuild
+            return int(f * 4 * L * W)  # all_gather of (W, 4, L) planes
         if self.hier_k:
             k, nn = self.hier_k, W // self.hier_k
             nb1 = (L // k) // self.cfg.block
@@ -569,19 +676,17 @@ class GradReducer:
     # canonical-slot reduction (elastic training; no collectives)
     # ------------------------------------------------------------------ #
 
-    def _reduce_canonical_flat(self, v, res):
-        """One bucket, canonical mode: (C, L) per-slot contributions ->
-        (bit-identical-on-any-mesh) mean over the slot axis.
-
-        Single-phase quantize->dequantize per slot with per-slot error
-        feedback, then the graph-fixed pairwise tree — no collective ops;
-        GSPMD materializes whatever data movement the tree implies, which
-        keeps the math independent of the device count."""
+    def _canonical_wire_rows(self, v, res):
+        """Per-slot wire math for canonical mode: quantize->dequantize
+        each (slot) row with per-slot error feedback. Row-local — every
+        op touches one row at a time, so under the shard_map in
+        :meth:`reduce_canonical` it runs entirely on the slot's owner
+        device, independent of the process layout."""
         cfg = self.cfg
         ef = cfg.error_feedback
-        C = self.canonical
-        if cfg.mode == "fp32":
-            return pairwise_slot_sum(v) / C, res
+        if cfg.mode in ("fp32", "lossless"):
+            # lossless is exact transport — per-slot it IS the fp32 math
+            return v, res
         c = v + res["e"] if ef else v
         if cfg.mode == "bf16":
             out = c.astype(jnp.bfloat16).astype(jnp.float32)
@@ -596,7 +701,16 @@ class GradReducer:
                 return dequantize_int8_blocks(q, s)
             out = jax.vmap(qdq)(c)
         new_res = {"e": c - out} if ef else res
-        return pairwise_slot_sum(out) / C, new_res
+        return out, new_res
+
+    def _reduce_canonical_flat(self, v, res):
+        """One bucket, canonical mode, eager reference: (C, L) per-slot
+        contributions -> mean over the slot axis via the graph-fixed
+        pairwise tree. The jitted path (:meth:`reduce_canonical`) wraps
+        the same row math in a shard_map so the tree's data movement is
+        an exact all_gather rather than whatever GSPMD would lower."""
+        out, new_res = self._canonical_wire_rows(v, res)
+        return pairwise_slot_sum(out) / self.canonical, new_res
 
     def reduce_canonical(self, slot_tree, state):
         """Reduce a tree of per-slot grads ((canonical, *shape) leaves,
@@ -613,13 +727,30 @@ class GradReducer:
                 f"grad tree has {len(leaves)} leaves but the bucket plan "
                 f"was built for {self.plan.n_leaves}")
         res_sh = NamedSharding(self.mesh, P(self.axis, None))
+        C = self.canonical
+
+        def bucket_body(rows, res_b):
+            # wire math on the slot's owner device, then an exact
+            # all_gather of the dequantized fp32 rows and the pairwise
+            # tree computed locally on every device — the grouping of
+            # adds can never depend on the device->process mapping
+            out, nr = self._canonical_wire_rows(rows, res_b)
+            gathered = jax.lax.all_gather(out, self.axis, axis=0,
+                                          tiled=True)
+            return pairwise_slot_sum(gathered) / C, nr
+
         outs = [None] * self.plan.n_leaves
         new_state = []
         for b, rb in zip(self.plan.buckets, state):
             flat = jax.vmap(lambda *ls: bucketing.pack(b, list(ls)))(
                 *[leaves[i] for i in b.leaf_ids])  # (C, padded)
             flat = jax.lax.with_sharding_constraint(flat, res_sh)
-            red, nr = self._reduce_canonical_flat(flat, rb)
+            res_spec = {k: P(self.axis, None) for k in rb}
+            fn = shard_map(bucket_body, mesh=self.mesh,
+                           in_specs=(P(self.axis, None), res_spec),
+                           out_specs=(P(), res_spec),
+                           **_SHMAP_CHECK_KWARGS)
+            red, nr = fn(flat, rb)
             for i, leaf in zip(b.leaf_ids, bucketing.unpack(b, red)):
                 outs[i] = leaf
             new_state.append({
@@ -725,8 +856,8 @@ class GradReducer:
         (and keeps EF dynamics) where the reducer owns no collective."""
         cfg = self.cfg
         ef = cfg.error_feedback
-        if cfg.mode == "fp32":
-            return v, res
+        if cfg.mode in ("fp32", "lossless"):
+            return v, res  # lossless wire format is exact: identity here
         c = v + res["e"] if ef else v
         if cfg.mode == "bf16":
             out = c.astype(jnp.bfloat16).astype(jnp.float32)
@@ -739,7 +870,7 @@ class GradReducer:
         return out, {"e": c - out if ef else res["e"]}
 
     def _transform_residual_shapes(self, b: bucketing.Bucket):
-        if self.cfg.mode == "fp32":
+        if self.cfg.mode in ("fp32", "lossless"):
             return {}
         return {"e": b.padded}
 

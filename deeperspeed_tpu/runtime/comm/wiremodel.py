@@ -13,7 +13,15 @@ fp32          fp32 reduce-scatter + all-gather 64
 bf16          bf16 both phases                 32
 int8          blockwise int8 + fp32 scales     16 + 64/block
 compressed    fp16-mantissa + int8 blocks      48
+lossless      byte-plane all_gather (exact)    32·w / 2 per phase
 ============  ===============================  ====================
+
+``lossless`` is gather-based (every rank ships its full exact fp32
+payload as byte planes), so its cost grows with the world size — the
+w-aware branch in :func:`plan_wire_bytes`. That trade is why it pairs
+with the hierarchical schedule on fleets: :func:`hier_wire_split`
+prices the intra-host and cross-host hops separately so the tuner can
+weigh the slow hop's bytes against the in-host ring.
 
 Per-device bytes use the standard ring factor ``2·(w−1)/w`` (one
 reduce-scatter pass plus one all-gather pass, each moving
@@ -31,6 +39,7 @@ from .bucketing import BucketPlan
 from .config import MODES, CommConfig
 
 __all__ = [
+    "hier_wire_split",
     "mode_wire_bits",
     "plan_collective_launches",
     "plan_wire_bytes",
@@ -39,7 +48,8 @@ __all__ = [
 ]
 
 
-def mode_wire_bits(mode: str, block: int = 128) -> float:
+def mode_wire_bits(mode: str, block: int = 128,
+                   world: int = 2) -> float:
     """Total bits per gradient element across both collective phases."""
     if mode not in MODES:
         raise ValueError(f"unknown comm mode {mode!r}; valid: {list(MODES)}")
@@ -50,6 +60,11 @@ def mode_wire_bits(mode: str, block: int = 128) -> float:
     if mode == "int8":
         # int8 payload both phases + one fp32 scale per block per phase
         return 16.0 + 64.0 / max(1, int(block))
+    if mode == "lossless":
+        # gather-based: the result every device assembles is w exact
+        # fp32 payloads; normalized by 2 phases to fit the shared
+        # padded * bits/8 * 2 * ring_factor formula
+        return 32.0 * max(2, int(world)) / 2.0
     return 48.0  # compressed: 24-bit (fp16 mantissa + int8 block exponent)
 
 
@@ -63,9 +78,48 @@ def plan_wire_bytes(plan: BucketPlan, cfg: CommConfig, world: int) -> int:
     """Per-device bytes on the wire for one full reduction of ``plan``."""
     if world <= 1:
         return 0
-    bits = mode_wire_bits(cfg.mode, cfg.block)
+    bits = mode_wire_bits(cfg.mode, cfg.block, world)
     padded = sum(b.padded for b in plan.buckets)
     return int(padded * bits / 8.0 * 2.0 * ring_factor(world))
+
+
+def hier_wire_split(plan: BucketPlan, cfg: CommConfig, world: int,
+                    intra_size: int) -> Dict[str, float]:
+    """Per-device bytes of the two-level schedule, split by hop — the
+    numbers a fleet cost model weighs against the in-host vs cross-host
+    link speeds. Supports the two hierarchical modes ("int8" and
+    "lossless"); the intra hops are fp32 in both.
+
+    Returns ``{"intra_bytes", "inter_bytes", "total_bytes"}``.
+    """
+    k = int(intra_size)
+    if world <= 1 or k <= 1 or world % k:
+        raise ValueError(
+            f"hier_wire_split needs intra_size > 1 dividing world "
+            f"(got intra_size={intra_size}, world={world})")
+    if cfg.mode not in ("int8", "lossless"):
+        raise ValueError(
+            f'hier_wire_split applies to modes "int8" and "lossless", '
+            f'got "{cfg.mode}"')
+    nn = world // k
+    fi = ring_factor(k)       # intra group ring fraction
+    fx = ring_factor(nn)      # inter (cross-host) group fraction
+    L = sum(b.padded for b in plan.buckets)
+    chunk = L // k
+    if cfg.mode == "lossless":
+        intra = fi * (4.0 * chunk          # fp32 RS of my host's share
+                      + 4.0 * L)           # fp32 AG rebuild
+        inter = fx * (nn * 4.0 * chunk)    # byte-plane AG across hosts
+    else:
+        nb1 = chunk // cfg.block
+        intra = fi * (4.0 * chunk                       # fp32 RS
+                      + L + 4.0 * k * nb1)              # int8 AG rebuild
+        inter = fx * (nn * (chunk + 4.0 * nb1))         # int8 AG + scales
+    return {
+        "intra_bytes": float(int(intra)),
+        "inter_bytes": float(int(inter)),
+        "total_bytes": float(int(intra + inter)),
+    }
 
 
 def plan_collective_launches(plan: BucketPlan, world: int) -> int:

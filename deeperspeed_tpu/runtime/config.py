@@ -479,6 +479,37 @@ class TrainingConfig:
             except ValueError as e:
                 raise ConfigError(f'invalid "lifecycle" block: {e}') from e
 
+        # ---- distributed (multi-host runtime) ----
+        # A "distributed" block configures the jax.distributed
+        # rendezvous: coordinator address and process shape (or
+        # environment discovery), init/heartbeat timeouts with retry
+        # backoff, the CPU collectives backend, and the per-host
+        # rendezvous record directory. Validated eagerly so a typo'd
+        # coordinator address fails at load, not after a rendezvous
+        # timeout.
+        self.distributed_params = pd.get(c.DISTRIBUTED, None)
+        if self.distributed_params is not None and not isinstance(
+                self.distributed_params, dict):
+            raise ConfigError(
+                '"distributed" must be a dict of DistributedConfig '
+                'overrides (or {"enabled": false})'
+            )
+        explicit_dist = (self.distributed_params or {}).get(
+            c.DISTRIBUTED_ENABLED)
+        self.distributed_enabled = (
+            explicit_dist if explicit_dist is not None
+            else self.distributed_params is not None
+        )
+        self._distributed_config = None
+        if self.distributed_enabled:
+            from ..distributed.config import DistributedConfig
+
+            try:
+                self._distributed_config = DistributedConfig.from_dict(
+                    dict(self.distributed_params, enabled=True))
+            except ValueError as e:
+                raise ConfigError(f'invalid "distributed" block: {e}') from e
+
         # ---- fused Pallas kernels ----
         # A "kernels" block selects the fused elementwise/optimizer/
         # super-tile attention kernels (ops/kernel_config.py): mode
@@ -579,6 +610,11 @@ class TrainingConfig:
         """The "lifecycle" block as a LifecycleConfig (None when absent
         or disabled); validated at parse time like "mesh"."""
         return self._lifecycle_config
+
+    def distributed_config(self):
+        """The "distributed" block as a DistributedConfig (None when
+        absent or disabled); validated at parse time like "lifecycle"."""
+        return self._distributed_config
 
     def get_sparse_attention(self, num_heads: int):
         """Build the configured SparsityConfig (reference runtime/config.py:213

@@ -305,6 +305,20 @@ LIFECYCLE_ENABLED = "enabled"
 LIFECYCLE_ENABLED_DEFAULT = False
 
 #############################################
+# Multi-host runtime (distributed/ package): a "distributed" block
+# configures the jax.distributed rendezvous — coordinator address,
+# process id/count (or environment discovery), init/heartbeat
+# timeouts with retry backoff, the CPU collectives backend for
+# cross-process reductions on CPU meshes, and the per-host rendezvous
+# record directory. Keys are validated by
+# distributed.config.DistributedConfig.from_dict; block presence
+# enables unless {"enabled": false}.
+#############################################
+DISTRIBUTED = "distributed"
+DISTRIBUTED_ENABLED = "enabled"
+DISTRIBUTED_ENABLED_DEFAULT = False
+
+#############################################
 # Autotune (autotune/ package): an "autotune" block records search
 # preferences a config opts into (quick space, cap, confirm steps) for
 # `python -m deeperspeed_tpu.autotune`; a "provenance" block is the

@@ -113,6 +113,16 @@ class Engine(ConfigAccessorsMixin):
         self.loss_fn = model
         self.module = model  # reference-compatible alias
         self.mpu = mpu
+        # multi-host: a "distributed" block brings jax.distributed up
+        # BEFORE the mesh is built, so MeshConfig layouts resolve over
+        # the global (process-spanning) device list. Idempotent — a
+        # launcher that already called init_distributed is adopted.
+        dist_cfg = (config.distributed_config()
+                    if hasattr(config, "distributed_config") else None)
+        if dist_cfg is not None:
+            from ..distributed import bootstrap as _dist_bootstrap
+
+            _dist_bootstrap.bootstrap(dist_cfg)
         if mesh is None:
             mesh_cfg = (config.mesh_config()
                         if hasattr(config, "mesh_config") else None)
@@ -521,8 +531,19 @@ class Engine(ConfigAccessorsMixin):
             def leaf(x, s):
                 # copy=True: the engine owns (and later donates) its state, so
                 # it must never alias caller-provided arrays
+                sh = NamedSharding(mesh, s)
+                if (jax.process_count() > 1
+                        and not sh.is_fully_addressable
+                        and getattr(x, "is_fully_addressable", True)):
+                    # collective-free global placement (every process holds
+                    # the same init value); device_put would broadcast each
+                    # leaf for a cross-process equality assert
+                    arr = np.array(jax.device_get(x),
+                                   dtype=dtype or x.dtype, copy=True)
+                    return jax.make_array_from_callback(
+                        arr.shape, sh, lambda idx: arr[idx])
                 arr = jnp.array(x, dtype=dtype or x.dtype, copy=True)
-                return jax.device_put(arr, NamedSharding(mesh, s))
+                return jax.device_put(arr, sh)
 
             return jax.tree.map(leaf, tree, specs)
 
@@ -901,15 +922,23 @@ class Engine(ConfigAccessorsMixin):
             if C:
                 # canonical path: slots subsume the gas microbatches (one
                 # vmap lane per slot; the scaled-grad divisor is C inside
-                # the slot mean, so the update body unscales with gas=1)
-                from .comm.reducer import pairwise_slot_sum
+                # the slot mean, so the update body unscales with gas=1).
+                # Slot means go through exact_slot_mean — an explicit
+                # all_gather + local pairwise tree — because inside the
+                # jit GSPMD may lower a sliced-add tree over the sharded
+                # slot axis to a native all-reduce whose accumulation
+                # order tracks the device->process topology (one ulp
+                # between gloo and shared-memory, enough to fork the
+                # loss curve across process layouts).
+                from .comm.reducer import exact_slot_mean
 
                 if self.comm is not None:
                     def canon_comm_fn(state, comm_state, batch, lr, rng):
                         rng = self._fold_rng(rng)
                         losses, slots = self._batch_grads_canonical(
                             state, batch, rng, C)
-                        loss = pairwise_slot_sum(losses) / C
+                        loss = exact_slot_mean(
+                            losses, self.mesh, self.batch_axes, C)
                         grads, new_comm = self.comm.reduce_canonical(
                             slots, comm_state)
                         grads = jax.tree.map(
@@ -927,11 +956,12 @@ class Engine(ConfigAccessorsMixin):
                     rng = self._fold_rng(rng)
                     losses, slots = self._batch_grads_canonical(
                         state, batch, rng, C)
-                    loss = pairwise_slot_sum(losses) / C
+                    loss = exact_slot_mean(
+                        losses, self.mesh, self.batch_axes, C)
                     grads = jax.tree.map(
-                        lambda g: (pairwise_slot_sum(g) / C).astype(
-                            self._grad_dtype),
-                        slots)
+                        lambda g: g.astype(self._grad_dtype),
+                        exact_slot_mean(slots, self.mesh,
+                                        self.batch_axes, C))
                     grads = partition.constrain(
                         grads, self.grad_specs, self.mesh)
                     new_state, metrics = self._apply_update_body(
@@ -1720,15 +1750,21 @@ class Engine(ConfigAccessorsMixin):
                 * self.data_parallel_size
                 * self.gradient_accumulation_steps())
 
-    def _host_checkpoint_payload(self, state=None, client_state=None):
+    def _host_checkpoint_payload(self, state=None, client_state=None,
+                                 comm_state=None):
         """Blocking device->host snapshot of everything a legacy-layout
         checkpoint stores, keyed by destination filename. The resilience
         manager takes this at the step boundary and hands it to the
         background writer (the arrays are host numpy, so training can
         mutate device state while the write proceeds); the sync save
-        path writes the same payload inline."""
+        path writes the same payload inline. ``comm_state`` overrides the
+        live residuals with an already-replicated snapshot (the
+        multi-process single-writer path must not device_get the sharded
+        originals — their shards live on other hosts)."""
         if state is None:
             state = self.state
+        if comm_state is None:
+            comm_state = self._comm_state
         model_states = {
             "module": to_host(state.params),
             "global_steps": self.global_steps,
@@ -1767,7 +1803,7 @@ class Engine(ConfigAccessorsMixin):
             # error-feedback residuals: quantized modes need them to
             # resume bit-identically (a dropped residual replays the
             # quantization error into the next update)
-            optim_states["comm"] = to_host(self._comm_state)
+            optim_states["comm"] = to_host(comm_state)
             optim_states["comm_fingerprint"] = repr(
                 self.comm.state_fingerprint())
             # layout descriptor for the elastic reshard path: a resume at
@@ -1792,7 +1828,7 @@ class Engine(ConfigAccessorsMixin):
             return False
         try:
             self._comm_state = jax.tree.map(
-                lambda x, s: jax.device_put(np.asarray(x, np.float32), s),
+                lambda x, s: _device_put_global(x, s, np.float32),
                 resharded, self.comm.state_shardings())
         except Exception as e:
             logger.warning(
@@ -1835,7 +1871,7 @@ class Engine(ConfigAccessorsMixin):
                 host_state = [host_state[k]
                               for k in sorted(host_state, key=int)]
             self._comm_state = jax.tree.map(
-                lambda x, s: jax.device_put(np.asarray(x, np.float32), s),
+                lambda x, s: _device_put_global(x, s, np.float32),
                 list(host_state), self.comm.state_shardings())
         except Exception as e:
             logger.warning(
@@ -2085,6 +2121,7 @@ class Engine(ConfigAccessorsMixin):
                 "(replicating) layout"
             )
         state = self.state
+        comm_snapshot = None
         if jax.process_count() > 1:
             # single-writer layout: replicate device state so every process
             # holds an addressable full copy (a jitted identity with
@@ -2092,6 +2129,10 @@ class Engine(ConfigAccessorsMixin):
             # process 0 writes. The scalable alternative is
             # checkpoint.sharded_io (orbax per-shard parallel write).
             state = self._fully_replicate(state)
+            if self.comm is not None and jax.tree.leaves(self._comm_state):
+                # error-feedback residuals are sharded P(axis, None) across
+                # processes too — same replication, same single writer
+                comm_snapshot = self._fully_replicate(self._comm_state)
             if self._offload is not None and jax.process_index() != 0:
                 # under offload each process is the ONLY holder of its master
                 # shards/moments: persist them per-rank (the analog of the
@@ -2107,7 +2148,8 @@ class Engine(ConfigAccessorsMixin):
             if jax.process_index() != 0:
                 return True
         for fname, tree in self._host_checkpoint_payload(
-                state=state, client_state=client_state).items():
+                state=state, client_state=client_state,
+                comm_state=comm_snapshot).items():
             ck.save(fname, tree)
         if save_latest and jax.process_index() == 0:
             write_latest(save_dir, tag)
@@ -2392,8 +2434,8 @@ class Engine(ConfigAccessorsMixin):
 
         def put(tree_host, specs, dtype):
             return jax.tree.map(
-                lambda x, s: jax.device_put(
-                    jnp.asarray(x, dtype), NamedSharding(mesh, s)
+                lambda x, s: _device_put_global(
+                    x, NamedSharding(mesh, s), dtype
                 ),
                 _retree(tree_host, self.state.params),
                 specs,
@@ -2428,8 +2470,8 @@ class Engine(ConfigAccessorsMixin):
                 )
             elif state.master is not None and optim_states.get("master"):
                 master = jax.tree.map(
-                    lambda x, s: jax.device_put(
-                        jnp.asarray(x, jnp.float32), NamedSharding(mesh, s)
+                    lambda x, s: _device_put_global(
+                        x, NamedSharding(mesh, s), jnp.float32
                     ),
                     _retree(optim_states["master"], self.state.master),
                     self.master_specs,
@@ -2440,8 +2482,8 @@ class Engine(ConfigAccessorsMixin):
                 # chunks are the source of truth and the device opt_state
                 # is (), which a non-offload checkpoint cannot populate
                 opt_state = jax.tree.map(
-                    lambda x, ref: jax.device_put(
-                        jnp.asarray(x, ref.dtype), ref.sharding),
+                    lambda x, ref: _device_put_global(
+                        x, ref.sharding, ref.dtype),
                     _retree(optim_states["opt_state"], self.state.opt_state),
                     self.state.opt_state,
                 )
@@ -2569,6 +2611,23 @@ def _retree(host_tree, ref_tree):
     from flax import serialization
 
     return serialization.from_state_dict(ref_tree, host_tree)
+
+
+def _device_put_global(x, sharding, dtype=None):
+    """Place a host value onto a (possibly process-spanning) sharding.
+
+    ``jax.device_put`` of a host array onto a non-addressable sharding
+    broadcasts the FULL array for a cross-process equality assert —
+    one collective per leaf, which is slow and desyncs against any
+    concurrently-issued collective. ``make_array_from_callback`` builds
+    the same global array purely from local shards, collective-free;
+    every process passes the same host value (checkpoint loads do: all
+    processes read the same files)."""
+    arr = np.asarray(x, dtype)
+    if jax.process_count() > 1 and not sharding.is_fully_addressable:
+        return jax.make_array_from_callback(
+            arr.shape, sharding, lambda idx: arr[idx])
+    return jax.device_put(jnp.asarray(arr), sharding)
 
 
 # ---------------------------------------------------------------------- #

@@ -143,7 +143,12 @@ def init_distributed(
         logger.info("num_processes<=1; running single-process.")
         return False
 
-    import jax
+    # Route through the distributed/ bootstrap so the legacy entry
+    # point gets the same rendezvous semantics as a "distributed"
+    # config block: gloo CPU collectives when the mesh is CPU-backed
+    # (the jaxlib default backend cannot execute cross-process
+    # collectives at all), heartbeat mapping, retry with backoff.
+    from ..distributed import bootstrap as _bootstrap
 
     logger.info(
         "jax.distributed.initialize(coordinator=%s, num_processes=%d, "
@@ -152,10 +157,8 @@ def init_distributed(
         num_processes,
         process_id,
     )
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
-    )
+    _bootstrap._apply_cpu_collectives("auto", num_processes)
+    _bootstrap.initialize_jax_distributed(
+        coordinator_address, num_processes, process_id)
     _initialized = True
     return True

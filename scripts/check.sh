@@ -94,6 +94,23 @@ assert ok, why
 print(f"  emitted config verifies: {why}")
 EOF
 
+echo "== multi-host smoke (2-process localhost mesh, probe-guarded) =="
+# a REAL 2-process jax.distributed rendezvous on this host: bootstrap
+# both workers over the gloo coordinator, build the process-spanning
+# mesh, run one psum across hosts. Skipped (with a note) where the
+# jaxlib lacks multiprocess CPU collectives — the probe IS the gate's
+# skip condition, same as tests/test_multihost.py
+if JAX_PLATFORMS=cpu python -m deeperspeed_tpu.distributed.bootstrap \
+        >/dev/null 2>&1; then
+    JAX_PLATFORMS=cpu python - <<'EOF'
+from deeperspeed_tpu.distributed.bootstrap import multiprocess_cpu_probe
+assert multiprocess_cpu_probe(), "probe passed as CLI but not as API"
+print("  2-process localhost rendezvous + cross-host psum OK")
+EOF
+else
+    echo "  no multiprocess CPU collectives in this jaxlib — skipped"
+fi
+
 echo "== perf ledger =="
 JAX_PLATFORMS=cpu python -m deeperspeed_tpu.monitor.ledger check
 
